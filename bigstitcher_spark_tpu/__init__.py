@@ -1,0 +1,17 @@
+"""bigstitcher_spark_tpu — a TPU-native distributed stitching & fusion framework.
+
+A from-scratch reimplementation of the capabilities of BigStitcher-Spark
+(JaneliaSciComp/BigStitcher-Spark) designed for TPU hardware: JAX/XLA compute
+kernels sharded over a ``jax.sharding.Mesh``, tensorstore-backed chunked IO
+(N5 / OME-ZARR / HDF5), and a BigStitcher-compatible SpimData XML project model
+so every stage's output remains verifiable with the BigStitcher GUI.
+
+Layer map (mirrors reference SURVEY.md §1, redesigned TPU-first):
+  L5  cli/       typed click commands, one per pipeline stage
+  L4  io/spimdata + utils/viewselect: project model & view selection
+  L3  parallel/  work-list sharding over devices, retry tracking
+  L2  ops/       XLA kernels: fusion, DoG, phase correlation, RANSAC, solver
+  L1  io/        tensorstore N5/zarr/HDF5 chunk IO, interestpoints.n5 store
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,21 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh (the analogue of the
+reference's Spark `local[N]` testing mode, SURVEY.md §4). Must run before any
+jax import."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def synthetic_project(tmp_path):
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    return make_synthetic_project(str(tmp_path / "proj"))
