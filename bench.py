@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Benchmark: affine-fusion voxels/sec (the BASELINE.md north-star metric).
+
+Fuses a 2x2-tile synthetic light-sheet project (256x256x128 per tile,
+uint16, AVG_BLEND) into an OME-ZARR container on the available accelerator
+and reports fused output voxels per second for the steady-state (warm
+compile-cache) run.
+
+vs_baseline: the reference publishes no numbers (BASELINE.json.published={}),
+so the comparison point is the documented estimate of BigStitcher-Spark on
+Spark local[8] CPU for this workload: ~2e7 fused voxels/sec (order of
+magnitude from the reference's own stage self-timing hooks; BASELINE.md §
+"Metrics"). vs_baseline = measured / 2e7, i.e. the ≥4x north-star target is
+vs_baseline >= 4.
+"""
+
+import json
+import os
+import shutil
+import sys
+import time
+
+BASELINE_VOX_PER_SEC = 2.0e7
+FIXTURE = os.environ.get("BST_BENCH_DIR", "/tmp/bst_bench")
+
+
+def build_fixture():
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    marker = os.path.join(FIXTURE, "proj", "dataset.xml")
+    if os.path.exists(marker):
+        return marker
+    shutil.rmtree(FIXTURE, ignore_errors=True)
+    make_synthetic_project(
+        os.path.join(FIXTURE, "proj"),
+        n_tiles=(2, 2, 1), tile_size=(256, 256, 128), overlap=32,
+        jitter=0.0, seed=11, block_size=(128, 128, 64),
+        n_beads_per_tile=120,
+    )
+    return marker
+
+
+def run_fusion(xml_path, out_path, block_scale=(2, 2, 1)):
+    from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+    from bigstitcher_spark_tpu.io.container import create_fusion_container
+    from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+    from bigstitcher_spark_tpu.io.spimdata import SpimData
+    from bigstitcher_spark_tpu.models.affine_fusion import fuse_volume
+    from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+    sd = SpimData.load(xml_path)
+    loader = ViewLoader(sd)
+    views = sd.view_ids()
+    bbox = maximal_bounding_box(sd, views)
+    shutil.rmtree(out_path, ignore_errors=True)
+    create_fusion_container(
+        out_path, StorageFormat.ZARR, xml_path, 1, 1, bbox,
+        data_type="uint16", block_size=(128, 128, 64),
+        min_intensity=0.0, max_intensity=65535.0,
+    )
+    store = ChunkStore.open(out_path)
+    ds = store.open_dataset("0")
+    stats = fuse_volume(
+        sd, loader, views, ds, bbox, block_size=(128, 128, 64),
+        block_scale=block_scale, fusion_type="AVG_BLEND",
+        out_dtype="uint16", min_intensity=0.0, max_intensity=65535.0,
+        zarr_ct=(0, 0),
+    )
+    return stats
+
+
+def main():
+    xml = build_fixture()
+    out = os.path.join(FIXTURE, "fused.ome.zarr")
+    # warm-up: compiles all (block,patch,view) bucket variants
+    run_fusion(xml, out)
+    # measured steady-state run
+    stats = run_fusion(xml, out)
+    vox_per_sec = stats.voxels / max(stats.seconds, 1e-9)
+    print(json.dumps({
+        "metric": "affine_fusion_voxels_per_sec",
+        "value": round(vox_per_sec, 1),
+        "unit": "voxel/s",
+        "vs_baseline": round(vox_per_sec / BASELINE_VOX_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

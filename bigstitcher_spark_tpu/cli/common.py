@@ -1,0 +1,71 @@
+"""Shared CLI options (the L4 base-class hierarchy of the reference,
+abstractcmdline/*.java, re-expressed as click decorator stacks)."""
+
+from __future__ import annotations
+
+import functools
+
+import click
+
+from ..io.spimdata import SpimData
+
+
+def infrastructure_options(f):
+    """--dryRun etc. (AbstractInfrastructure.java:14-27)."""
+    f = click.option("--dryRun", "dry_run", is_flag=True, default=False,
+                     help="compute but do not persist results")(f)
+    return f
+
+
+def xml_option(f):
+    """-x/--xml (AbstractBasic.java:43-70)."""
+    return click.option("-x", "--xml", "xml", required=True,
+                        type=click.Path(exists=True),
+                        help="path to the SpimData XML project")(f)
+
+
+def view_selection_options(f):
+    """view subset flags (AbstractSelectableViews.java:38-112)."""
+    for opt in (
+        click.option("--angleId", "angle_ids", default=None,
+                     help="comma-separated angle ids to process"),
+        click.option("--channelId", "channel_ids", default=None,
+                     help="comma-separated channel ids to process"),
+        click.option("--illuminationId", "illumination_ids", default=None,
+                     help="comma-separated illumination ids to process"),
+        click.option("--tileId", "tile_ids", default=None,
+                     help="comma-separated tile ids to process"),
+        click.option("--timepointId", "timepoint_ids", default=None,
+                     help="comma-separated timepoint ids to process"),
+        click.option("-vi", "vi", multiple=True,
+                     help="explicit view ids 'timepoint,setup' (repeatable)"),
+    ):
+        f = opt(f)
+    return f
+
+
+def load_project(xml: str) -> SpimData:
+    return SpimData.load(xml)
+
+
+def parse_csv_ints(s: str | None, n: int | None = None) -> list[int] | None:
+    if s is None:
+        return None
+    vals = [int(v) for v in s.split(",")]
+    if n is not None and len(vals) != n:
+        raise click.BadParameter(f"expected {n} comma-separated ints: {s!r}")
+    return vals
+
+
+def select_views_from_kwargs(sd, kwargs):
+    from ..utils.viewselect import select_views
+
+    return select_views(
+        sd,
+        angle_ids=kwargs.get("angle_ids"),
+        channel_ids=kwargs.get("channel_ids"),
+        illumination_ids=kwargs.get("illumination_ids"),
+        tile_ids=kwargs.get("tile_ids"),
+        timepoint_ids=kwargs.get("timepoint_ids"),
+        vi=kwargs.get("vi"),
+    )

@@ -1,0 +1,268 @@
+"""``create-fusion-container`` and ``affine-fusion`` commands.
+
+Reference tools: CreateFusionContainer.java (driver-only container setup) and
+SparkAffineFusion.java (the distributed fusion workload). Flag names follow
+the reference CLI surface.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import click
+import numpy as np
+
+from ..io.chunkstore import ChunkStore, StorageFormat
+from ..io.container import (
+    create_fusion_container,
+    estimate_multires_pyramid,
+    read_container_meta,
+)
+from ..io.dataset_io import ViewLoader
+from ..io.spimdata import SpimData, ViewId
+from ..models.affine_fusion import BlendParams, fuse_volume
+from ..ops.fusion import FUSION_TYPES
+from ..utils.geometry import Interval
+from ..utils.viewselect import (
+    anisotropy_factor_from_voxel_sizes,
+    maximal_bounding_box,
+)
+from .common import (
+    infrastructure_options,
+    parse_csv_ints,
+    select_views_from_kwargs,
+    view_selection_options,
+    xml_option,
+)
+
+_DTYPES = ("UINT8", "UINT16", "FLOAT32")
+
+
+@click.command()
+@xml_option
+@view_selection_options
+@infrastructure_options
+@click.option("-o", "--output", "output", required=True,
+              help="output container path (.n5 / .zarr)")
+@click.option("-s", "--storage", type=click.Choice(["N5", "ZARR", "HDF5"]),
+              default="ZARR", help="storage format")
+@click.option("-d", "--dataType", "data_type",
+              type=click.Choice(_DTYPES), default="FLOAT32")
+@click.option("--blockSize", "block_size", default="128,128,128",
+              help="block size, e.g. 128,128,64")
+@click.option("--bdv", is_flag=True, default=False,
+              help="write a BDV-project layout (+XML) instead of a plain container")
+@click.option("--xmlout", "xml_out", default=None,
+              help="output XML path for --bdv")
+@click.option("--multiRes", "multi_res", is_flag=True, default=False,
+              help="automatically create a multiresolution pyramid")
+@click.option("-ds", "--downsampling", "downsampling", multiple=True,
+              help="manual pyramid steps, e.g. -ds 1,1,1 -ds 2,2,1 -ds 4,4,2")
+@click.option("--preserveAnisotropy", "preserve_anisotropy", is_flag=True,
+              default=False)
+@click.option("--anisotropyFactor", "anisotropy_factor", type=float,
+              default=float("nan"))
+@click.option("--minIntensity", "min_intensity", type=float, default=None)
+@click.option("--maxIntensity", "max_intensity", type=float, default=None)
+@click.option("--boundingBox", "bounding_box", default=None,
+              help="use a named bounding box from the XML instead of the maximal one")
+@click.option("--compression", default="zstd",
+              type=click.Choice(["zstd", "gzip", "raw", "blosc"]))
+def create_fusion_container_cmd(xml, output, storage, data_type, block_size,
+                                bdv, xml_out, multi_res, downsampling,
+                                preserve_anisotropy, anisotropy_factor,
+                                min_intensity, max_intensity, bounding_box,
+                                compression, dry_run, **kwargs):
+    """Create an empty fusion output container + metadata (driver-only)."""
+    sd = SpimData.load(xml)
+    views = select_views_from_kwargs(sd, kwargs)
+    storage_format = StorageFormat(storage)
+
+    channels = sorted({sd.setups[v.setup].attributes.get("channel", 0) for v in views})
+    tps = sorted({v.timepoint for v in views})
+    num_channels, num_timepoints = len(channels), len(tps)
+
+    if preserve_anisotropy and not np.isfinite(anisotropy_factor):
+        anisotropy_factor = anisotropy_factor_from_voxel_sizes(sd, views)
+
+    from ..models.affine_fusion import anisotropy_transform
+
+    aniso = anisotropy_transform(anisotropy_factor) if preserve_anisotropy else None
+    if bounding_box is not None:
+        if bounding_box not in sd.bounding_boxes:
+            raise click.ClickException(
+                f"bounding box {bounding_box!r} not in XML; "
+                f"have {sorted(sd.bounding_boxes)}"
+            )
+        bbox = sd.bounding_boxes[bounding_box]
+        if aniso is not None:
+            mn = list(bbox.min); mx = list(bbox.max)
+            mn[2] = int(np.round(mn[2] / anisotropy_factor))
+            mx[2] = int(np.round(mx[2] / anisotropy_factor))
+            bbox = Interval(mn, mx)
+    else:
+        bbox = maximal_bounding_box(sd, views, aniso)
+
+    bs = parse_csv_ints(block_size, 3)
+    if downsampling:
+        ds = [parse_csv_ints(d, 3) for d in downsampling]
+    elif multi_res:
+        ds = estimate_multires_pyramid(bbox.shape, anisotropy_factor
+                                       if preserve_anisotropy else float("nan"))
+    else:
+        ds = [[1, 1, 1]]
+
+    click.echo(f"BoundingBox: {bbox.min} -> {bbox.max} dims={bbox.shape}")
+    click.echo(f"numChannels={num_channels} numTimepoints={num_timepoints}")
+    click.echo(f"pyramid: {ds}")
+    if dry_run:
+        click.echo("(dry run, not writing)")
+        return
+
+    meta = create_fusion_container(
+        output, storage_format, os.path.abspath(xml),
+        num_timepoints, num_channels, bbox,
+        data_type=data_type.lower(), block_size=bs, downsamplings=ds,
+        compression=compression, bdv=bdv,
+        preserve_anisotropy=preserve_anisotropy,
+        anisotropy_factor=anisotropy_factor,
+        min_intensity=min_intensity, max_intensity=max_intensity,
+    )
+    if bdv:
+        _write_bdv_output_xml(xml_out or output + ".xml", output, meta, storage_format)
+    click.echo(f"created {meta.fusion_format} container at {output}")
+
+
+def _write_bdv_output_xml(xml_out: str, container: str, meta, storage_format) -> None:
+    """Minimal BDV project XML for the fused dataset
+    (SpimData2Tools.createNewSpimDataForFusion role)."""
+    from ..io.spimdata import (
+        AttributeEntity, ImageLoader, SpimData, ViewSetup, ViewTransform,
+    )
+    from ..utils.geometry import identity_affine
+
+    out = SpimData()
+    fmt = "bdv.n5" if storage_format == StorageFormat.N5 else "bdv.zarr"
+    out.image_loader = ImageLoader(format=fmt, path=os.path.abspath(container),
+                                  path_type="absolute")
+    out.timepoints = list(range(meta.num_timepoints))
+    dims = meta.bbox.shape
+    out.attributes["illumination"][0] = AttributeEntity(0, "0")
+    out.attributes["angle"][0] = AttributeEntity(0, "0")
+    out.attributes["tile"][0] = AttributeEntity(0, "0")
+    for c in range(meta.num_channels):
+        out.attributes["channel"][c] = AttributeEntity(c, f"Channel {c}")
+        out.setups[c] = ViewSetup(
+            id=c, name=f"setup {c}", size=tuple(dims),
+            attributes={"illumination": 0, "channel": c, "tile": 0, "angle": 0},
+        )
+        for t in range(meta.num_timepoints):
+            out.registrations[ViewId(t, c)] = [
+                ViewTransform("fused", identity_affine())
+            ]
+    out.save(xml_out)
+
+
+@click.command()
+@infrastructure_options
+@click.option("-o", "--output", "output", required=True,
+              help="fusion container created by create-fusion-container")
+@view_selection_options
+@click.option("--fusionType", "fusion_type",
+              type=click.Choice(FUSION_TYPES, case_sensitive=False),
+              default="AVG_BLEND")
+@click.option("--blockScale", "block_scale", default="2,2,1",
+              help="how many container blocks per compute block")
+@click.option("--masks", is_flag=True, default=False,
+              help="write coverage masks instead of fused data")
+@click.option("--maskOffset", "mask_offset", default="0.0,0.0,0.0")
+@click.option("--blendingRange", "blending_range", default="40,40,40")
+@click.option("--blendingBorder", "blending_border", default="0,0,0")
+@click.option("--channelIndex", "channel_index", type=int, default=None,
+              help="process only this channel index of the container")
+@click.option("--timepointIndex", "timepoint_index", type=int, default=None,
+              help="process only this timepoint index of the container")
+def affine_fusion_cmd(output, fusion_type, block_scale, masks, mask_offset,
+                      blending_range, blending_border, channel_index,
+                      timepoint_index, dry_run, **kwargs):
+    """Fuse all views into the prepared container (THE workload)."""
+    t_start = time.time()
+    store = ChunkStore.open(output)
+    try:
+        meta = read_container_meta(store)
+    except ValueError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"FusionFormat: {meta.fusion_format}; bbox {meta.bbox.min}->"
+               f"{meta.bbox.max}; dataType {meta.data_type}")
+    sd = SpimData.load(meta.input_xml)
+    loader = ViewLoader(sd)
+    all_views = select_views_from_kwargs(sd, kwargs)
+
+    blend = BlendParams(
+        border=tuple(float(v) for v in blending_border.split(",")),
+        range=tuple(float(v) for v in blending_range.split(",")),
+    )
+    bscale = parse_csv_ints(block_scale, 3)
+    is_zarr5d = meta.fusion_format in ("OME-ZARR", "BDV/OME-ZARR")
+
+    # container channel/timepoint indices are positions in the FULL sorted
+    # lists — keep them stable under --channelIndex/--timepointIndex filtering
+    # so data lands in the matching mr_infos dataset / zarr slot
+    channels = sorted({sd.setups[v.setup].attributes.get("channel", 0)
+                       for v in all_views})
+    tps = sorted({v.timepoint for v in all_views})
+    c_indices = ([channel_index] if channel_index is not None
+                 else list(range(len(channels))))
+    t_indices = ([timepoint_index] if timepoint_index is not None
+                 else list(range(len(tps))))
+    moff = tuple(float(v) for v in mask_offset.split(","))
+
+    total_vox = 0
+    for ti in t_indices:
+        t = tps[ti]
+        for ci in c_indices:
+            c = channels[ci]
+            views = [
+                v for v in all_views
+                if v.timepoint == t
+                and sd.setups[v.setup].attributes.get("channel", 0) == c
+            ]
+            if not views:
+                continue
+            mr = meta.mr_infos[ci + ti * meta.num_channels]
+            ds = store.open_dataset(mr[0].dataset.strip("/"))
+            click.echo(f"fusing channel {c} timepoint {t}: {len(views)} views "
+                       f"-> {mr[0].dataset}")
+            if dry_run:
+                continue
+            stats = fuse_volume(
+                sd, loader, views, ds, meta.bbox,
+                block_size=tuple(meta.block_size), block_scale=tuple(bscale),
+                fusion_type=fusion_type.upper(), blend=blend,
+                anisotropy_factor=(meta.anisotropy_factor
+                                   if meta.preserve_anisotropy else float("nan")),
+                out_dtype=meta.data_type,
+                min_intensity=meta.min_intensity,
+                max_intensity=meta.max_intensity,
+                masks=masks,
+                mask_offset=moff,
+                zarr_ct=(ci, ti) if is_zarr5d else None,
+            )
+            total_vox += stats.voxels
+            click.echo(f"  {stats.voxels} voxels in {stats.seconds:.2f}s "
+                       f"({stats.voxels / max(stats.seconds, 1e-9):,.0f} vox/s; "
+                       f"{stats.skipped_empty} empty blocks skipped)")
+            if len(mr) > 1 and not dry_run:
+                _write_pyramid(store, mr, is_zarr5d, (ci, ti))
+    click.echo(f"done, {total_vox} voxels, took {time.time() - t_start:.1f}s")
+
+
+def _write_pyramid(store, mr_levels, is_zarr5d, ct):
+    """Downsample s0 into the remaining pyramid levels
+    (SparkAffineFusion.java:703-782)."""
+    from ..models.downsample_driver import downsample_pyramid_level
+
+    for lvl in range(1, len(mr_levels)):
+        downsample_pyramid_level(store, mr_levels[lvl - 1], mr_levels[lvl],
+                                 is_zarr5d, ct)

@@ -1,0 +1,33 @@
+"""CLI entry point: one subcommand per pipeline stage, names matching the
+reference's installed shell wrappers (install:122-139) so users of
+BigStitcher-Spark can switch 1:1.
+
+Run: ``python -m bigstitcher_spark_tpu.cli.main <tool> [options]``
+"""
+
+from __future__ import annotations
+
+import click
+
+from . import fusion_tools
+
+
+@click.group()
+def cli():
+    """TPU-native BigStitcher: distributed stitching & fusion tools."""
+
+
+cli.add_command(fusion_tools.create_fusion_container_cmd, "create-fusion-container")
+cli.add_command(fusion_tools.affine_fusion_cmd, "affine-fusion")
+
+
+def register(module_names: list[str]) -> None:
+    pass
+
+
+def main():
+    cli(prog_name="bst")
+
+
+if __name__ == "__main__":
+    main()

@@ -240,7 +240,6 @@ class ChunkStore:
                 },
                 "create": True,
                 "delete_existing": delete_existing,
-                "open": not delete_existing,
             }
             arr = ts.open(spec).result()
             return Dataset(self, path, arr, reversed_axes=False)
@@ -257,7 +256,6 @@ class ChunkStore:
                 "metadata": meta,
                 "create": True,
                 "delete_existing": delete_existing,
-                "open": not delete_existing,
             }
             arr = ts.open(spec).result()
             return Dataset(self, path, arr, reversed_axes=True)
@@ -348,6 +346,10 @@ class Hdf5Store:
         if delete_existing and path in self._f:
             del self._f[path]
         kw = {}
+        if compression not in ("raw", "gzip"):
+            raise ValueError(
+                f"HDF5 store supports only gzip/raw compression, got {compression!r}"
+            )
         if compression != "raw":
             kw["compression"] = "gzip"
         d = self._f.create_dataset(

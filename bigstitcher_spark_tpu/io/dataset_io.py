@@ -57,6 +57,7 @@ def create_bdv_view_datasets(
         ds = store.create_dataset(
             bdv_dataset_path(setup, timepoint, level),
             lshape, block_size, dtype, compression=compression,
+            delete_existing=True,
         )
         store.set_attribute(ds.path, "downsamplingFactors", [int(v) for v in f])
         out.append(ds)
@@ -76,10 +77,15 @@ class ViewLoader:
             raise FileNotFoundError(f"image container not found: {root}")
         self.store = ChunkStore.open(root)
         self._cache: dict[tuple, Dataset] = {}
+        self._factors_cache: dict[int, list[list[int]]] = {}
 
     def downsampling_factors(self, setup: int) -> list[list[int]]:
-        f = self.store.get_attribute(f"setup{setup}", "downsamplingFactors")
-        return [[int(v) for v in row] for row in (f or [[1, 1, 1]])]
+        if setup not in self._factors_cache:
+            f = self.store.get_attribute(f"setup{setup}", "downsamplingFactors")
+            self._factors_cache[setup] = [
+                [int(v) for v in row] for row in (f or [[1, 1, 1]])
+            ]
+        return self._factors_cache[setup]
 
     def num_levels(self, setup: int) -> int:
         return len(self.downsampling_factors(setup))

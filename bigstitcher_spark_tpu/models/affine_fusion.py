@@ -1,0 +1,430 @@
+"""Affine fusion driver: plan blocks, prefetch patches, run the XLA kernel.
+
+The TPU redesign of SparkAffineFusion's per-block map (reference call stack
+SURVEY.md §3.1): the work list is the output block grid (strategy P1); per
+block the host finds overlapping views (OverlappingViews.java:28-47),
+prefetches the exact source boxes the inverse affine needs
+(ViewUtil.findOverlappingBlocks role), buckets shapes, and launches one fused
+XLA computation. Writers own disjoint storage chunks; halos are over-read —
+both reference invariants preserved.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..io.chunkstore import Dataset
+from ..io.dataset_io import ViewLoader, best_mipmap_level
+from ..io.spimdata import SpimData, ViewId
+from ..ops import fusion as F
+from ..utils.geometry import (
+    Interval,
+    concatenate,
+    concatenate_all,
+    invert_affine,
+    scale_affine,
+    translation_affine,
+    transformed_interval,
+)
+from ..utils.grid import GridBlock, create_grid
+from .. import profiling
+
+
+@dataclass
+class BlendParams:
+    """Cosine blending configuration (mvrecon FusionTools defaults)."""
+
+    border: tuple[float, float, float] = (0.0, 0.0, 0.0)
+    range: tuple[float, float, float] = (40.0, 40.0, 40.0)
+
+
+@dataclass
+class FusionStats:
+    voxels: int = 0
+    blocks: int = 0
+    skipped_empty: int = 0
+    seconds: float = 0.0
+    compile_keys: set = field(default_factory=set)
+
+
+def anisotropy_transform(factor: float) -> np.ndarray:
+    """Concatenate (1,1,1/f) scaling into all view models
+    (TransformVirtual.adjustAllTransforms, SparkAffineFusion.java:487-491)."""
+    if not np.isfinite(factor) or factor == 1.0:
+        return None
+    return scale_affine((1.0, 1.0, 1.0 / factor))
+
+
+@dataclass
+class _ViewPlan:
+    patch_offset: np.ndarray  # (3,) int, level coords
+    patch_interval: Interval
+    affine: np.ndarray        # (3,4) block idx -> patch coords
+    inv_total: np.ndarray     # (3,4) world -> level coords
+    img_dim: np.ndarray       # (3,) level image dims
+    level: int
+    view: ViewId
+
+    @property
+    def is_translation(self) -> bool:
+        """True when sampling is a pure (sub-pixel) shift — the no-gather
+        fast path applies (ops.fusion.fuse_block_shift)."""
+        return bool(np.allclose(self.inv_total[:, :3], np.eye(3), atol=1e-7))
+
+
+def plan_block(
+    sd: SpimData,
+    loader: ViewLoader,
+    views: list[ViewId],
+    block_global: Interval,
+    anisotropy: np.ndarray | None,
+) -> list[_ViewPlan]:
+    """Find views overlapping this output block and their needed source boxes."""
+    plans: list[_ViewPlan] = []
+    for v in views:
+        model = sd.model(v)
+        if anisotropy is not None:
+            model = concatenate(anisotropy, model)
+        factors = loader.downsampling_factors(v.setup)
+        level = best_mipmap_level(factors, (1.0, 1.0, 1.0))
+        mip = loader.mipmap_transform(v.setup, level)
+        total = concatenate(model, mip)  # level coords -> world
+        inv_total = invert_affine(total)
+        src = transformed_interval(inv_total, block_global).expand(1)
+        img_shape = loader.open(v, level).shape
+        img_iv = Interval.from_shape(img_shape)
+        # +2 px tolerance like OverlappingViews (fusion/OverlappingViews.java:28-47)
+        if not src.overlaps(img_iv.expand(2)):
+            continue
+        clipped = src.intersect(img_iv)
+        if clipped.is_empty():
+            continue
+        patch_offset = np.array(clipped.min, dtype=np.float64)
+        aff = concatenate(
+            translation_affine(-patch_offset),
+            concatenate(inv_total, translation_affine(block_global.min)),
+        )
+        plans.append(
+            _ViewPlan(
+                patch_offset=np.array(clipped.min, dtype=np.int64),
+                patch_interval=clipped,
+                affine=aff,
+                inv_total=inv_total,
+                img_dim=np.array(img_shape, dtype=np.float64),
+                level=level,
+                view=v,
+            )
+        )
+    return plans
+
+
+def fuse_grid_block(
+    sd: SpimData,
+    loader: ViewLoader,
+    views: list[ViewId],
+    block: GridBlock,
+    bbox: Interval,
+    fusion_type: str = "AVG_BLEND",
+    blend: BlendParams | None = None,
+    anisotropy: np.ndarray | None = None,
+    patch_quantum: int = 32,
+    compute_block_shape: tuple[int, ...] | None = None,
+    stats: FusionStats | None = None,
+    inside_offset: tuple[float, float, float] = (0.0, 0.0, 0.0),
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Fuse one grid block. Returns (fused f32, weight f32) arrays of
+    ``block.size``, or None when no view overlaps (block left empty —
+    reference skips saving empty blocks)."""
+    blend = blend or BlendParams()
+    bshape = tuple(compute_block_shape or block.size)
+    block_global = Interval.from_shape(bshape, block.offset).translate(bbox.min)
+    plans = plan_block(sd, loader, views, block_global, anisotropy)
+    if not plans:
+        return None
+
+    if all(p.is_translation for p in plans):
+        return _fuse_shift_path(
+            loader, plans, block, block_global, bshape, fusion_type, blend,
+            stats, inside_offset,
+        )
+
+    vb = F.bucket_views(len(plans))
+    pshape = F.bucket_shape(
+        np.max([p.patch_interval.shape for p in plans], axis=0), patch_quantum
+    )
+    patches = np.zeros((vb, *pshape), dtype=np.float32)
+    affines = np.zeros((vb, 3, 4), dtype=np.float32)
+    offsets = np.zeros((vb, 3), dtype=np.float32)
+    img_dims = np.ones((vb, 3), dtype=np.float32)
+    borders = np.zeros((vb, 3), dtype=np.float32)
+    ranges = np.ones((vb, 3), dtype=np.float32)
+    valid = np.zeros((vb,), dtype=np.float32)
+    for i, p in enumerate(plans):
+        with profiling.span("fusion.prefetch"):
+            patches[i] = loader.read_block(
+                p.view, p.level, tuple(p.patch_offset), pshape
+            ).astype(np.float32)
+        affines[i] = p.affine
+        offsets[i] = p.patch_offset
+        img_dims[i] = p.img_dim
+        factors = loader.downsampling_factors(p.view.setup)[p.level]
+        borders[i] = np.asarray(blend.border) / np.asarray(factors, dtype=np.float64)
+        ranges[i] = np.asarray(blend.range) / np.asarray(factors, dtype=np.float64)
+        valid[i] = 1.0
+
+    if stats is not None:
+        stats.compile_keys.add((bshape, pshape, vb, fusion_type))
+    ioffs = np.tile(np.asarray(inside_offset, np.float32), (vb, 1))
+    with profiling.span("fusion.kernel"):
+        fused, wsum = F.fuse_block(
+            patches, affines, offsets, img_dims, borders, ranges, valid,
+            block_shape=bshape, fusion_type=fusion_type, inside_offs=ioffs,
+        )
+        fused, wsum = np.asarray(fused), np.asarray(wsum)
+    # crop the static compute shape back to the (possibly clipped) block
+    sl = tuple(slice(0, s) for s in block.size)
+    return fused[sl], wsum[sl]
+
+
+def _fuse_shift_path(loader, plans, block, block_global, bshape, fusion_type,
+                     blend, stats, inside_offset=(0.0, 0.0, 0.0)):
+    """Translation-only blocks: 8-shifted-slice kernel, no gather, one compile
+    per (block shape, view bucket)."""
+    vb = F.bucket_views(len(plans))
+    pshape = tuple(s + 1 for s in bshape)
+    patches = np.zeros((vb, *pshape), dtype=np.float32)
+    fracs = np.zeros((vb, 3), dtype=np.float32)
+    lpos0 = np.zeros((vb, 3), dtype=np.float32)
+    img_dims = np.ones((vb, 3), dtype=np.float32)
+    borders = np.zeros((vb, 3), dtype=np.float32)
+    ranges = np.ones((vb, 3), dtype=np.float32)
+    valid = np.zeros((vb,), dtype=np.float32)
+    bg_min = np.asarray(block_global.min, dtype=np.float64)
+    for i, p in enumerate(plans):
+        tlevel = p.inv_total[:, :3] @ bg_min + p.inv_total[:, 3]
+        floor_off = np.floor(tlevel).astype(np.int64)
+        with profiling.span("fusion.prefetch"):
+            patches[i] = loader.read_block(
+                p.view, p.level, tuple(floor_off), pshape
+            ).astype(np.float32)
+        fracs[i] = tlevel - floor_off
+        lpos0[i] = tlevel
+        img_dims[i] = p.img_dim
+        factors = loader.downsampling_factors(p.view.setup)[p.level]
+        borders[i] = np.asarray(blend.border) / np.asarray(factors, dtype=np.float64)
+        ranges[i] = np.asarray(blend.range) / np.asarray(factors, dtype=np.float64)
+        valid[i] = 1.0
+    if stats is not None:
+        stats.compile_keys.add((bshape, "shift", vb, fusion_type))
+    ioffs = np.tile(np.asarray(inside_offset, np.float32), (vb, 1))
+    with profiling.span("fusion.kernel"):
+        fused, wsum = F.fuse_block_shift(
+            patches, fracs, lpos0, img_dims, borders, ranges, valid,
+            block_shape=bshape, fusion_type=fusion_type, inside_offs=ioffs,
+        )
+        fused, wsum = np.asarray(fused), np.asarray(wsum)
+    sl = tuple(slice(0, s) for s in block.size)
+    return fused[sl], wsum[sl]
+
+
+DEVICE_TILE_BUDGET_BYTES = int(
+    float(__import__("os").environ.get("BST_DEVICE_TILE_BUDGET", 4e9))
+)
+
+
+def _try_fuse_volume_device(
+    sd, loader, views, bbox, block_size, block_scale, fusion_type, blend,
+    anisotropy, out_dtype, min_intensity, max_intensity, masks, stats,
+    mask_offset=(0.0, 0.0, 0.0),
+):
+    """Whole-volume device-resident fusion (one dispatch, tiles live in HBM).
+
+    Applies when every view is translation-registered at a single level and
+    the tile stack fits the device budget; returns the fused (unpadded)
+    volume as numpy, or None to fall back to the per-block path."""
+    import jax
+    import jax.numpy as jnp
+
+    compute_block = tuple(b * s for b, s in zip(block_size, block_scale))
+    grid = create_grid(bbox.shape, compute_block, block_size)
+    all_plans: list[list[_ViewPlan]] = []
+    view_order: dict[ViewId, int] = {}
+    for block in grid:
+        block_global = Interval.from_shape(
+            compute_block, block.offset).translate(bbox.min)
+        plans = plan_block(sd, loader, views, block_global, anisotropy)
+        if any(not p.is_translation for p in plans):
+            return None
+        for p in plans:
+            view_order.setdefault(p.view, len(view_order))
+        all_plans.append(plans)
+    if not view_order:
+        return None
+    # uniform padded tile shape; must hold the slice window (block+1)
+    shapes = [loader.open(v, 0).shape for v in view_order]
+    levels = {p.level for plans in all_plans for p in plans}
+    if levels - {0}:
+        return None
+    tile_shape = tuple(
+        max(max(s[d] for s in shapes), compute_block[d] + 1) for d in range(3)
+    )
+    nbytes = len(view_order) * int(np.prod(tile_shape)) * 2
+    if nbytes > DEVICE_TILE_BUDGET_BYTES:
+        return None
+
+    with profiling.span("fusion.h2d_tiles"):
+        tiles_np = np.zeros((len(view_order), *tile_shape), dtype=np.uint16)
+        for v, i in view_order.items():
+            img = loader.open(v, 0).read_full()
+            if img.dtype != np.uint16:
+                return None  # uint16 staging only; others use per-block path
+            tiles_np[i, : img.shape[0], : img.shape[1], : img.shape[2]] = img
+        tiles = jax.device_put(tiles_np)
+
+    B = len(grid)
+    K = F.bucket_views(max((len(p) for p in all_plans), default=1))
+    view_idx = np.zeros((B, K), np.int32)
+    floor_offs = np.zeros((B, K, 3), np.int32)
+    fracs = np.zeros((B, K, 3), np.float32)
+    lpos0 = np.zeros((B, K, 3), np.float32)
+    img_dims = np.ones((B, K, 3), np.float32)
+    borders = np.zeros((B, K, 3), np.float32)
+    ranges = np.ones((B, K, 3), np.float32)
+    valid = np.zeros((B, K), np.float32)
+    inside_offs = np.zeros((B, K, 3), np.float32)
+    if masks:
+        inside_offs[:] = np.asarray(mask_offset, np.float32)
+    block_offsets = np.zeros((B, 3), np.int32)
+    for bi, (block, plans) in enumerate(zip(grid, all_plans)):
+        block_offsets[bi] = block.offset
+        bg_min = np.asarray(block.offset, np.float64) + np.asarray(bbox.min)
+        for ki, p in enumerate(plans):
+            tlevel = p.inv_total[:, :3] @ bg_min + p.inv_total[:, 3]
+            fo = np.floor(tlevel).astype(np.int64)
+            view_idx[bi, ki] = view_order[p.view]
+            floor_offs[bi, ki] = fo
+            fracs[bi, ki] = tlevel - fo
+            lpos0[bi, ki] = tlevel
+            img_dims[bi, ki] = p.img_dim
+            factors = loader.downsampling_factors(p.view.setup)[p.level]
+            borders[bi, ki] = np.asarray(blend.border) / np.asarray(factors)
+            ranges[bi, ki] = np.asarray(blend.range) / np.asarray(factors)
+            valid[bi, ki] = 1.0
+
+    padded = tuple(
+        int(np.ceil(bbox.shape[d] / compute_block[d]) * compute_block[d])
+        for d in range(3)
+    )
+    if stats is not None:
+        stats.compile_keys.add((padded, compute_block, K, fusion_type, "scan"))
+    with profiling.span("fusion.kernel"):
+        out = F.fuse_volume_scan(
+            tiles, view_idx, floor_offs, fracs, lpos0, img_dims, borders,
+            ranges, valid, block_offsets,
+            jnp.float32(min_intensity), jnp.float32(max_intensity),
+            out_shape=padded, block_shape=compute_block,
+            fusion_type=fusion_type, out_dtype=out_dtype, masks=masks,
+            inside_offs=inside_offs,
+        )
+        with profiling.span("fusion.d2h"):
+            out = np.asarray(out)
+    sl = tuple(slice(0, s) for s in bbox.shape)
+    return out[sl]
+
+
+def fuse_volume(
+    sd: SpimData,
+    loader: ViewLoader,
+    views: list[ViewId],
+    out_ds: Dataset,
+    bbox: Interval,
+    block_size: tuple[int, ...],
+    block_scale: tuple[int, ...] = (2, 2, 1),
+    fusion_type: str = "AVG_BLEND",
+    blend: BlendParams | None = None,
+    anisotropy_factor: float = float("nan"),
+    out_dtype: str = "float32",
+    min_intensity: float | None = None,
+    max_intensity: float | None = None,
+    masks: bool = False,
+    mask_offset: tuple[float, float, float] = (0.0, 0.0, 0.0),
+    zarr_ct: tuple[int, int] | None = None,
+    progress: bool = False,
+) -> FusionStats:
+    """Fuse ``views`` into ``out_ds`` over ``bbox``.
+
+    ``zarr_ct``: (channel, timepoint) indices when out_ds is a 5-D OME-ZARR
+    dataset (3-D block embedded at [...,c,t], SparkAffineFusion.java:630-651).
+    """
+    stats = FusionStats()
+    t0 = time.time()
+    aniso = anisotropy_transform(anisotropy_factor)
+    compute_block = tuple(b * s for b, s in zip(block_size, block_scale))
+    grid = create_grid(bbox.shape, compute_block, block_size)
+    if min_intensity is None or max_intensity is None:
+        if out_dtype == "uint8":
+            min_intensity, max_intensity = 0.0, 255.0
+        elif out_dtype == "uint16":
+            min_intensity, max_intensity = 0.0, 65535.0
+        else:
+            min_intensity, max_intensity = 0.0, 1.0
+
+    vol = _try_fuse_volume_device(
+        sd, loader, views, bbox, block_size, block_scale, fusion_type,
+        blend or BlendParams(), aniso, out_dtype, min_intensity,
+        max_intensity, masks, stats, mask_offset=mask_offset,
+    )
+    if vol is not None:
+        with profiling.span("fusion.write"):
+            if zarr_ct is not None:
+                c, t = zarr_ct
+                out_ds.write(vol[..., None, None], (0, 0, 0, c, t))
+            else:
+                out_ds.write(vol, (0, 0, 0))
+        stats.blocks = len(grid)
+        stats.voxels = bbox.num_elements
+        stats.seconds = time.time() - t0
+        return stats
+
+    def process(block: GridBlock) -> None:
+        res = fuse_grid_block(
+            sd, loader, views, block, bbox, fusion_type, blend, aniso,
+            compute_block_shape=compute_block, stats=stats,
+            inside_offset=mask_offset if masks else (0.0, 0.0, 0.0),
+        )
+        stats.blocks += 1
+        if res is None:
+            stats.skipped_empty += 1
+            return
+        fused, wsum = res
+        if masks:
+            out = (wsum > 0).astype(np.float32)
+            if out_dtype != "float32":
+                out *= float(np.iinfo(np.dtype(out_dtype)).max)
+            data = out.astype(out_dtype)
+        else:
+            data = np.asarray(
+                F.convert_intensity(
+                    fused, np.float32(min_intensity), np.float32(max_intensity),
+                    out_dtype=out_dtype,
+                )
+            )
+        with profiling.span("fusion.write"):
+            if zarr_ct is not None:
+                c, t = zarr_ct
+                out5 = data[..., None, None]
+                out_ds.write(out5, (*block.offset, c, t))
+            else:
+                out_ds.write(data, block.offset)
+        stats.voxels += int(np.prod(block.size))
+        if progress:
+            print(f"  block {block.offset} done ({len(grid)} total)")
+
+    from ..parallel.retry import run_with_retry
+
+    run_with_retry(grid, process, label="fusion block")
+    stats.seconds = time.time() - t0
+    return stats

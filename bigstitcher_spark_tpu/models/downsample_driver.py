@@ -1,0 +1,49 @@
+"""Pyramid-level writer: block-parallel 2x downsampling of an existing level
+(SparkAffineFusion.java:703-782 and SparkDownsample.java:141-177 equivalent).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.chunkstore import ChunkStore
+from ..io.container import MultiResolutionLevelInfo
+from ..ops.downsample import downsample_block
+from ..parallel.retry import run_with_retry
+from ..utils.grid import create_grid
+
+
+def downsample_pyramid_level(
+    store: ChunkStore,
+    src_info: MultiResolutionLevelInfo,
+    dst_info: MultiResolutionLevelInfo,
+    is_zarr5d: bool = False,
+    ct: tuple[int, int] = (0, 0),
+) -> None:
+    """Fill ``dst_info`` from ``src_info`` by relative-factor averaging."""
+    src = store.open_dataset(src_info.dataset.strip("/"))
+    dst = store.open_dataset(dst_info.dataset.strip("/"))
+    rel = [int(v) for v in dst_info.relativeDownsampling[:3]]
+    dims3 = [int(v) for v in dst_info.dimensions[:3]]
+    block3 = [int(v) for v in dst_info.blockSize[:3]]
+    grid = create_grid(dims3, block3)
+
+    def process(block):
+        src_off = [o * f for o, f in zip(block.offset, rel)]
+        src_size = [s * f for s, f in zip(block.size, rel)]
+        if is_zarr5d:
+            c, t = ct
+            data = src.read((*src_off, c, t), (*src_size, 1, 1))[..., 0, 0]
+        else:
+            data = src.read(src_off, src_size)
+        out = np.asarray(downsample_block(data, tuple(rel)))
+        if np.issubdtype(dst.dtype, np.integer):
+            out = np.clip(np.round(out), np.iinfo(dst.dtype).min,
+                          np.iinfo(dst.dtype).max)
+        out = out.astype(dst.dtype)
+        if is_zarr5d:
+            dst.write(out[..., None, None], (*block.offset, *ct))
+        else:
+            dst.write(out, block.offset)
+
+    run_with_retry(grid, process, label="downsample block")

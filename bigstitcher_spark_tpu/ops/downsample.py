@@ -1,0 +1,45 @@
+"""2x downsampling kernels (XLA).
+
+Reference equivalents: ``LazyHalfPixelDownsample2x`` (pyramid levels,
+SparkDownsample.java:159-177, SparkResaveN5.java:370-386) and
+``Downsample.simple2x`` / ``LazyDownsample2x`` (detection pre-downsampling,
+SparkInterestPointDetection.java:1094-1114). Both average pairs along one
+axis; the half-pixel variant pairs (2i, 2i+1) which together with the
+(f-1)/2 mipmap offset keeps coordinates consistent across levels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("factors",))
+def downsample_block(block: jnp.ndarray, factors: tuple[int, ...]) -> jnp.ndarray:
+    """Average-downsample a block by integer ``factors`` per axis.
+
+    Input extent must be an exact multiple of ``factors`` (drivers read
+    out_size*factor source voxels, which level dims guarantee in-bounds)."""
+    x = block.astype(jnp.float32)
+    for d, f in enumerate(factors):
+        f = int(f)
+        if f == 1:
+            continue
+        shape = list(x.shape)
+        shape[d] = shape[d] // f
+        shape.insert(d + 1, f)
+        x = x.reshape(shape).mean(axis=d + 1)
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("axis",))
+def halfpixel_downsample2x_axis(x: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """One chained 2x half-pixel step along ``axis`` (out[i]=(in[2i]+in[2i+1])/2)."""
+    n = x.shape[axis] // 2
+    sl0 = [slice(None)] * x.ndim
+    sl1 = [slice(None)] * x.ndim
+    sl0[axis] = slice(0, 2 * n, 2)
+    sl1[axis] = slice(1, 2 * n, 2)
+    return 0.5 * (x[tuple(sl0)].astype(jnp.float32) + x[tuple(sl1)].astype(jnp.float32))

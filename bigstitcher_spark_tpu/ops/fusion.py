@@ -1,0 +1,366 @@
+"""Affine-fusion XLA kernel: resample + blend all views into an output block.
+
+TPU-native re-design of the reference's core fusion pipeline
+(``BlkAffineFusion.initWithIntensityCoefficients``, SparkAffineFusion.java:602-615):
+for each output block, every overlapping view is inverse-affine resampled
+(tri-linear) out of a host-prefetched source patch, weighted with a cosine
+ramp at the image borders (FusionType AVG_BLEND), accumulated, and normalized.
+One fused XLA computation per (block shape, patch bucket, view bucket) — all
+shapes static, no data-dependent control flow; views are a vmapped leading
+axis and invalid/padded views are masked, so a single compile serves every
+block with the same bucket.
+
+Fusion types (reference enum use at SparkAffineFusion.java:124-125):
+AVG, AVG_BLEND, MAX_INTENSITY, FIRST_WINS (lowest view wins),
+LAST_WINS (highest view wins).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FUSION_TYPES = ("AVG", "AVG_BLEND", "MAX_INTENSITY", "FIRST_WINS", "LAST_WINS")
+
+
+def block_coords(block_shape: Sequence[int]) -> jnp.ndarray:
+    """(N,3) float32 local voxel indices of a block, N = prod(shape)."""
+    bx, by, bz = block_shape
+    gx, gy, gz = jnp.meshgrid(
+        jnp.arange(bx, dtype=jnp.float32),
+        jnp.arange(by, dtype=jnp.float32),
+        jnp.arange(bz, dtype=jnp.float32),
+        indexing="ij",
+    )
+    return jnp.stack([gx.ravel(), gy.ravel(), gz.ravel()], axis=-1)
+
+
+def _trilinear_sample(patch: jnp.ndarray, pts: jnp.ndarray) -> jnp.ndarray:
+    """Sample one (Px,Py,Pz) patch at (N,3) float coords; clamped at edges."""
+    px, py, pz = patch.shape
+    p0 = jnp.floor(pts)
+    f = pts - p0
+    p0 = p0.astype(jnp.int32)
+    x0 = jnp.clip(p0[:, 0], 0, px - 1)
+    y0 = jnp.clip(p0[:, 1], 0, py - 1)
+    z0 = jnp.clip(p0[:, 2], 0, pz - 1)
+    x1 = jnp.clip(p0[:, 0] + 1, 0, px - 1)
+    y1 = jnp.clip(p0[:, 1] + 1, 0, py - 1)
+    z1 = jnp.clip(p0[:, 2] + 1, 0, pz - 1)
+    flat = patch.ravel()
+    syz = py * pz
+
+    def g(xi, yi, zi):
+        return jnp.take(flat, xi * syz + yi * pz + zi)
+
+    fx, fy, fz = f[:, 0], f[:, 1], f[:, 2]
+    c000 = g(x0, y0, z0) * (1 - fx) * (1 - fy) * (1 - fz)
+    c100 = g(x1, y0, z0) * fx * (1 - fy) * (1 - fz)
+    c010 = g(x0, y1, z0) * (1 - fx) * fy * (1 - fz)
+    c110 = g(x1, y1, z0) * fx * fy * (1 - fz)
+    c001 = g(x0, y0, z1) * (1 - fx) * (1 - fy) * fz
+    c101 = g(x1, y0, z1) * fx * (1 - fy) * fz
+    c011 = g(x0, y1, z1) * (1 - fx) * fy * fz
+    c111 = g(x1, y1, z1) * fx * fy * fz
+    return c000 + c100 + c010 + c110 + c001 + c101 + c011 + c111
+
+
+def _blend_weight(
+    lpos: jnp.ndarray, img_dim: jnp.ndarray, border: jnp.ndarray,
+    blend_range: jnp.ndarray,
+) -> jnp.ndarray:
+    """Cosine border-ramp blending weight at level-image coords lpos (N,3).
+
+    Per dim: distance to the (border-offset) image edge; 0 outside, cosine
+    ramp over ``blend_range`` px, 1 in the interior; total = product
+    (mvrecon BlendingRealRandomAccess semantics)."""
+    lo = border  # (3,)
+    hi = img_dim - 1.0 - border
+    d = jnp.minimum(lpos - lo, hi - lpos)  # (N,3) distance to nearest edge
+    r = jnp.maximum(blend_range, 1e-6)
+    ramp = 0.5 * (jnp.cos((1.0 - d / r) * jnp.pi) + 1.0)
+    w = jnp.where(d < 0, 0.0, jnp.where(d < r, ramp, 1.0))
+    return jnp.prod(w, axis=-1)
+
+
+def _sample_one_view(patch, affine, patch_offset, img_dim, border, blend_range,
+                     inside_off, coords):
+    """Per-view: transform block coords, sample, weight. Returns (val, w).
+
+    ``inside_off`` expands (+) or shrinks (-) the image box used for the
+    inside test — the reference's ``--maskOffset`` for masks mode
+    (GenerateComputeBlockMasks, fusion/GenerateComputeBlockMasks.java:84-177)."""
+    p = coords @ affine[:, :3].T + affine[:, 3]  # patch coords (N,3)
+    val = _trilinear_sample(patch, p)
+    lpos = p + patch_offset  # level-image coords
+    inside = jnp.all(
+        (lpos >= -inside_off) & (lpos <= img_dim - 1.0 + inside_off), axis=-1
+    ).astype(jnp.float32)
+    w_blend = _blend_weight(lpos, img_dim, border, blend_range)
+    return val, inside, w_blend
+
+
+def fuse_block_impl(
+    patches: jnp.ndarray,        # (V, Px, Py, Pz) float32
+    affines: jnp.ndarray,        # (V, 3, 4) float32: block idx -> patch coords
+    patch_offsets: jnp.ndarray,  # (V, 3) float32: patch origin in level coords
+    img_dims: jnp.ndarray,       # (V, 3) float32
+    borders: jnp.ndarray,        # (V, 3) float32
+    blend_ranges: jnp.ndarray,   # (V, 3) float32
+    valid: jnp.ndarray,          # (V,) float32 1/0 (padding mask)
+    block_shape: tuple[int, int, int],
+    fusion_type: str = "AVG_BLEND",
+    inside_offs: jnp.ndarray | None = None,  # (V, 3) mask-offset expansion
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fuse one output block. Returns (fused float32 block, weight-sum block).
+
+    Weight-sum doubles as the coverage mask for ``--masks`` mode
+    (GenerateComputeBlockMasks equivalent)."""
+    if inside_offs is None:
+        inside_offs = jnp.zeros_like(borders)
+    coords = block_coords(block_shape)
+    vals, insides, wblends = jax.vmap(
+        _sample_one_view, in_axes=(0, 0, 0, 0, 0, 0, 0, None)
+    )(patches, affines, patch_offsets, img_dims, borders, blend_ranges,
+      inside_offs, coords)
+    fused, wsum = _combine_views(vals, insides, wblends, valid, fusion_type)
+    return (fused.reshape(block_shape), wsum.reshape(block_shape))
+
+
+fuse_block = jax.jit(
+    fuse_block_impl, static_argnames=("block_shape", "fusion_type")
+)
+
+
+# ---------------------------------------------------------------------------
+# Translation fast path: no gather at all.
+#
+# When a view's inverse affine has an identity linear part (the common case:
+# translation-registered tiles, which is everything before/after a
+# translation-model solve), sampling degenerates to EIGHT STATICALLY-SHIFTED
+# SLICES of the patch with constant trilinear corner weights, and the blend
+# weight is separable per axis. That is pure elementwise arithmetic — the
+# shape XLA/TPU wants — instead of 8 random gathers per voxel. The host
+# planner picks this kernel per block (models/affine_fusion.py).
+# ---------------------------------------------------------------------------
+
+
+def _axis_blend(lp0, n: int, dim, border, blend_range, inside_off=0.0):
+    """1-D blend weight + inside mask along one axis, positions lp0+[0..n)."""
+    pos = lp0 + jnp.arange(n, dtype=jnp.float32)
+    lo = border
+    hi = dim - 1.0 - border
+    d = jnp.minimum(pos - lo, hi - pos)
+    r = jnp.maximum(blend_range, 1e-6)
+    ramp = 0.5 * (jnp.cos((1.0 - d / r) * jnp.pi) + 1.0)
+    w = jnp.where(d < 0, 0.0, jnp.where(d < r, ramp, 1.0))
+    inside = ((pos >= -inside_off) & (pos <= dim - 1.0 + inside_off)).astype(
+        jnp.float32)
+    return w, inside
+
+
+def _one_view_shift(patch, frac, lpos0, img_dim, border, blend_range,
+                    inside_off, block_shape):
+    bx, by, bz = block_shape
+    fx, fy, fz = frac[0], frac[1], frac[2]
+    val = jnp.zeros(block_shape, jnp.float32)
+    for cx in (0, 1):
+        wxc = fx if cx else 1.0 - fx
+        for cy in (0, 1):
+            wyc = fy if cy else 1.0 - fy
+            for cz in (0, 1):
+                wzc = fz if cz else 1.0 - fz
+                sl = jax.lax.slice(
+                    patch, (cx, cy, cz), (cx + bx, cy + by, cz + bz)
+                )
+                val = val + (wxc * wyc * wzc) * sl
+    wx, ix = _axis_blend(lpos0[0], bx, img_dim[0], border[0], blend_range[0],
+                         inside_off[0])
+    wy, iy = _axis_blend(lpos0[1], by, img_dim[1], border[1], blend_range[1],
+                         inside_off[1])
+    wz, iz = _axis_blend(lpos0[2], bz, img_dim[2], border[2], blend_range[2],
+                         inside_off[2])
+    blend = wx[:, None, None] * wy[None, :, None] * wz[None, None, :]
+    inside = ix[:, None, None] * iy[None, :, None] * iz[None, None, :]
+    return val, inside, blend
+
+
+def _combine_views(vals, insides, wblends, valid, fusion_type: str):
+    """Combine per-view samples (V, ...) by fusion type -> (fused, wsum)."""
+    extra = (1,) * (vals.ndim - 1)
+    vmask = valid.reshape(valid.shape + extra)
+    if fusion_type == "AVG":
+        w = insides * vmask
+    elif fusion_type == "AVG_BLEND":
+        w = insides * wblends * vmask
+    elif fusion_type == "MAX_INTENSITY":
+        w = insides * vmask
+        fused = jnp.max(jnp.where(w > 0, vals, -jnp.inf), axis=0)
+        wsum = jnp.sum(w, axis=0)
+        return jnp.where(wsum > 0, fused, 0.0), wsum
+    elif fusion_type in ("FIRST_WINS", "LAST_WINS"):
+        inside = insides * vmask
+        V = vals.shape[0]
+        order = jnp.arange(V, dtype=jnp.float32).reshape((V,) + extra)
+        if fusion_type == "FIRST_WINS":
+            pick = jnp.where(inside > 0, order, jnp.inf)
+            sel = jnp.argmin(pick, axis=0)
+        else:
+            pick = jnp.where(inside > 0, order, -jnp.inf)
+            sel = jnp.argmax(pick, axis=0)
+        fused = jnp.take_along_axis(vals, sel[None], axis=0)[0]
+        wsum = jnp.sum(inside, axis=0)
+        return jnp.where(wsum > 0, fused, 0.0), wsum
+    else:
+        raise ValueError(f"unknown fusion type {fusion_type}")
+    wsum = jnp.sum(w, axis=0)
+    acc = jnp.sum(vals * w, axis=0)
+    fused = jnp.where(wsum > 0, acc / jnp.maximum(wsum, 1e-20), 0.0)
+    return fused, wsum
+
+
+def fuse_block_shift_impl(
+    patches: jnp.ndarray,       # (V, bx+1, by+1, bz+1) float32
+    fracs: jnp.ndarray,         # (V, 3) in [0,1)
+    lpos0: jnp.ndarray,         # (V, 3) level coords of output voxel (0,0,0)
+    img_dims: jnp.ndarray,      # (V, 3)
+    borders: jnp.ndarray,       # (V, 3)
+    blend_ranges: jnp.ndarray,  # (V, 3)
+    valid: jnp.ndarray,         # (V,)
+    block_shape: tuple[int, int, int],
+    fusion_type: str = "AVG_BLEND",
+    inside_offs: jnp.ndarray | None = None,  # (V, 3)
+):
+    if inside_offs is None:
+        inside_offs = jnp.zeros_like(borders)
+    vals, insides, wblends = jax.vmap(
+        _one_view_shift, in_axes=(0, 0, 0, 0, 0, 0, 0, None)
+    )(patches, fracs, lpos0, img_dims, borders, blend_ranges, inside_offs,
+      block_shape)
+    return _combine_views(vals, insides, wblends, valid, fusion_type)
+
+
+fuse_block_shift = jax.jit(
+    fuse_block_shift_impl, static_argnames=("block_shape", "fusion_type")
+)
+
+
+# ---------------------------------------------------------------------------
+# Device-resident volume fusion: one dispatch per (channel, timepoint) volume.
+#
+# Host<->device transfers are the scarce resource (PCIe, or worse a tunnel);
+# the per-block path moves every patch across it. Here the source tiles are
+# uploaded ONCE as a uint16 stack living in HBM, a lax.scan walks the output
+# block grid — per block: gather the K relevant tiles, dynamic-slice the
+# needed window out of each, realign (roll) for out-of-range clamping, fuse
+# with the shifted-slice kernel — and dynamic-update-slices into the output
+# volume, which leaves the device exactly once, already converted to the
+# output dtype. The scan carry is donated, so XLA updates in place.
+# ---------------------------------------------------------------------------
+
+
+def _realign(S: jnp.ndarray, delta: jnp.ndarray) -> jnp.ndarray:
+    """patch[i] = S[(i + delta) mod n] per axis (wrapped entries are later
+    masked by the inside test, so wrap garbage never contributes)."""
+    for ax in range(3):
+        n = S.shape[ax]
+        shift = jnp.mod(delta[ax], n)
+        S2 = jnp.concatenate([S, S], axis=ax)
+        S = jax.lax.dynamic_slice_in_dim(S2, shift, n, axis=ax)
+    return S
+
+
+def _one_view_device(tile, floor_off, frac, lp0, img_dim, border, blend_range,
+                     inside_off, block_shape):
+    ps = tuple(s + 1 for s in block_shape)
+    tshape = jnp.array(tile.shape, jnp.int32)
+    lim = tshape - jnp.array(ps, jnp.int32)
+    clamp = jnp.clip(floor_off, 0, lim)
+    S = jax.lax.dynamic_slice(tile, tuple(clamp[d] for d in range(3)), ps)
+    S = _realign(S, floor_off - clamp).astype(jnp.float32)
+    return _one_view_shift(S, frac, lp0, img_dim, border, blend_range,
+                           inside_off, block_shape)
+
+
+def fuse_volume_scan_impl(
+    tiles: jnp.ndarray,          # (V, tx, ty, tz) uint16/float32, HBM-resident
+    view_idx: jnp.ndarray,       # (B, K) int32 into tiles
+    floor_offs: jnp.ndarray,     # (B, K, 3) int32
+    fracs: jnp.ndarray,          # (B, K, 3) float32
+    lpos0: jnp.ndarray,          # (B, K, 3) float32
+    img_dims: jnp.ndarray,       # (B, K, 3) float32 (true dims, pre-padding)
+    borders: jnp.ndarray,        # (B, K, 3) float32
+    blend_ranges: jnp.ndarray,   # (B, K, 3) float32
+    valid: jnp.ndarray,          # (B, K) float32
+    block_offsets: jnp.ndarray,  # (B, 3) int32 into the padded output volume
+    min_i: jnp.ndarray,
+    max_i: jnp.ndarray,
+    out_shape: tuple[int, int, int],   # padded to block multiples
+    block_shape: tuple[int, int, int],
+    fusion_type: str = "AVG_BLEND",
+    out_dtype: str = "float32",
+    masks: bool = False,
+    inside_offs: jnp.ndarray | None = None,  # (B, K, 3)
+):
+    if inside_offs is None:
+        inside_offs = jnp.zeros_like(borders)
+
+    def body(out, p):
+        vidx, fo, fr, lp, dim, bo, rg, va, io, boff = p
+        tiles_sel = jnp.take(tiles, vidx, axis=0)
+        vals, insides, wblends = jax.vmap(
+            _one_view_device, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None)
+        )(tiles_sel, fo, fr, lp, dim, bo, rg, io, block_shape)
+        fused, wsum = _combine_views(vals, insides, wblends, va, fusion_type)
+        res = (wsum > 0).astype(jnp.float32) if masks else fused
+        out = jax.lax.dynamic_update_slice(out, res, tuple(boff[d] for d in range(3)))
+        return out, None
+
+    out0 = jnp.zeros(out_shape, jnp.float32)
+    out, _ = jax.lax.scan(
+        body, out0,
+        (view_idx, floor_offs, fracs, lpos0, img_dims, borders, blend_ranges,
+         valid, inside_offs, block_offsets),
+    )
+    if masks:
+        info_max = (1.0 if out_dtype == "float32"
+                    else float(np.iinfo(np.dtype(out_dtype)).max))
+        return (out * info_max).astype(np.dtype(out_dtype))
+    return _convert_intensity_expr(out, min_i, max_i, out_dtype)
+
+
+fuse_volume_scan = jax.jit(
+    fuse_volume_scan_impl,
+    static_argnames=("out_shape", "block_shape", "fusion_type", "out_dtype",
+                     "masks"),
+)
+
+
+def _convert_intensity_expr(block, min_i, max_i, out_dtype: str):
+    """Map [min,max] -> full integer range (uint8/uint16) or pass float through
+    (reference type converters, SparkAffineFusion.java:497-517)."""
+    if out_dtype == "float32":
+        return block.astype(jnp.float32)
+    info = np.iinfo(np.dtype(out_dtype))
+    scaled = (block - min_i) / (max_i - min_i) * float(info.max)
+    return jnp.clip(jnp.round(scaled), 0, info.max).astype(np.dtype(out_dtype))
+
+
+convert_intensity = jax.jit(
+    _convert_intensity_expr, static_argnames=("out_dtype",)
+)
+
+
+def bucket_shape(shape: Sequence[int], quantum: int = 32) -> tuple[int, ...]:
+    """Round patch shapes up so recompiles are bounded (shape bucketing —
+    the central TPU dynamic-shape mitigation, SURVEY.md §7)."""
+    return tuple(int(np.ceil(max(int(s), 1) / quantum)) * quantum for s in shape)
+
+
+def bucket_views(n: int) -> int:
+    """Pad view count to the next power of two (>=1)."""
+    return 1 << max(0, int(np.ceil(np.log2(max(n, 1)))))

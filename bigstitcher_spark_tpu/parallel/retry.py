@@ -1,0 +1,60 @@
+"""App-level block retry (RetryTrackerSpark equivalent).
+
+The reference resubmits failed grid blocks ≤5 times with a 2 s delay, then
+gives up hard (RetryTrackerSpark.java:28-61; loops at
+SparkAffineFusion.java:467-479,682-696). Block writes are idempotent, so
+resubmission is always safe.
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+from typing import Callable, Iterable, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+class RetryError(RuntimeError):
+    pass
+
+
+def run_with_retry(
+    items: Sequence[T],
+    process: Callable[[T], None],
+    max_retries: int = 5,
+    delay_s: float = 2.0,
+    label: str = "block",
+    verbose: bool = True,
+) -> int:
+    """Process all items; collect failures and resubmit only those.
+
+    Returns the number of retry rounds used. Raises RetryError when items
+    still fail after ``max_retries`` rounds (reference exits the JVM)."""
+    pending: list[T] = list(items)
+    rounds = 0
+    while pending:
+        failed: list[tuple[T, Exception]] = []
+        for it in pending:
+            try:
+                process(it)
+            except Exception as e:  # noqa: BLE001 - any task failure is retryable
+                failed.append((it, e))
+        if not failed:
+            return rounds
+        rounds += 1
+        if rounds > max_retries:
+            tb = "".join(traceback.format_exception(failed[0][1]))
+            raise RetryError(
+                f"{len(failed)} {label}(s) still failing after "
+                f"{max_retries} retries; first error:\n{tb}"
+            )
+        if verbose:
+            print(
+                f"[retry] {len(failed)} {label}(s) failed "
+                f"(round {rounds}/{max_retries}), resubmitting in {delay_s}s: "
+                f"{failed[0][1]!r}"
+            )
+        time.sleep(delay_s)
+        pending = [it for it, _ in failed]
+    return rounds

@@ -1,0 +1,75 @@
+"""Lightweight tracing/profiling: named spans with aggregate wall-clock.
+
+The reference only prints per-stage ``currentTimeMillis`` deltas
+(SparkAffineFusion.java:424,470,698); we keep per-span aggregates
+(count/total/max) queryable in-process and printable per stage.
+Zero overhead when disabled.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SpanStat:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+
+
+class Profiler:
+    def __init__(self):
+        self.enabled = False
+        self._stats: dict[str, SpanStat] = defaultdict(SpanStat)
+        self._lock = threading.Lock()
+
+    def reset(self):
+        with self._lock:
+            self._stats.clear()
+
+    def record(self, name: str, dt: float):
+        with self._lock:
+            s = self._stats[name]
+            s.count += 1
+            s.total_s += dt
+            s.max_s = max(s.max_s, dt)
+
+    def stats(self) -> dict[str, SpanStat]:
+        with self._lock:
+            return {k: SpanStat(v.count, v.total_s, v.max_s)
+                    for k, v in self._stats.items()}
+
+    def report(self) -> str:
+        lines = ["span                            count    total_s      max_s"]
+        for k in sorted(self._stats):
+            s = self._stats[k]
+            lines.append(f"{k:<30} {s.count:>6} {s.total_s:>10.3f} {s.max_s:>10.3f}")
+        return "\n".join(lines)
+
+
+_global = Profiler()
+
+
+def enable(on: bool = True):
+    _global.enabled = on
+
+
+def get() -> Profiler:
+    return _global
+
+
+@contextlib.contextmanager
+def span(name: str):
+    if not _global.enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _global.record(name, time.perf_counter() - t0)

@@ -1,0 +1,84 @@
+"""CLI-level end-to-end: create-fusion-container → affine-fusion, the
+reference's own test pattern (TestSparkAffineFusion.java:8-36) on the
+synthetic fixture instead of the S3 dataset."""
+
+import json
+import os
+
+import numpy as np
+from click.testing import CliRunner
+
+from bigstitcher_spark_tpu.cli.main import cli
+from bigstitcher_spark_tpu.io.chunkstore import ChunkStore
+from bigstitcher_spark_tpu.io.container import read_container_meta
+from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+
+def test_container_then_fusion_zarr(tmp_path):
+    proj = make_synthetic_project(
+        str(tmp_path / "p"), n_tiles=(2, 2, 1), jitter=0.0, seed=7,
+        tile_size=(80, 80, 40), overlap=20,
+    )
+    out = str(tmp_path / "fused.ome.zarr")
+    runner = CliRunner()
+    r = runner.invoke(cli, [
+        "create-fusion-container", "-x", proj.xml_path, "-o", out,
+        "-s", "ZARR", "-d", "UINT16", "--blockSize", "64,64,32",
+        "--minIntensity", "0", "--maxIntensity", "3000",
+        "-ds", "1,1,1", "-ds", "2,2,2",
+    ])
+    assert r.exit_code == 0, r.output
+    store = ChunkStore.open(out)
+    meta = read_container_meta(store)
+    assert meta.fusion_format == "OME-ZARR"
+    # NGFF multiscales present
+    ms = store.get_attributes("")["multiscales"]
+    assert ms[0]["version"] == "0.4"
+    assert [a["name"] for a in ms[0]["axes"]] == ["t", "c", "z", "y", "x"]
+
+    r = runner.invoke(cli, [
+        "affine-fusion", "-o", out, "--fusionType", "AVG_BLEND",
+        "--blockScale", "1,1,1",
+    ])
+    assert r.exit_code == 0, r.output
+    ds = store.open_dataset("0")
+    full = ds.read((0, 0, 0, 0, 0), (*meta.bbox.shape, 1, 1))[..., 0, 0]
+    assert full.dtype == np.uint16
+    assert full.max() > 1000  # beads visible after rescale to [0,3000]
+    assert (full > 0).mean() > 0.8  # near-full coverage (uniform background>0)
+    # pyramid level written
+    lvl1 = store.open_dataset("1")
+    l1 = lvl1.read((0, 0, 0, 0, 0), (*lvl1.shape[:3], 1, 1))[..., 0, 0]
+    assert l1.max() > 500
+
+
+def test_fusion_masks_mode(tmp_path):
+    proj = make_synthetic_project(
+        str(tmp_path / "p"), n_tiles=(2, 1, 1), jitter=0.0, seed=8,
+    )
+    out = str(tmp_path / "mask.n5")
+    runner = CliRunner()
+    r = runner.invoke(cli, [
+        "create-fusion-container", "-x", proj.xml_path, "-o", out,
+        "-s", "N5", "-d", "UINT8", "--blockSize", "64,64,32",
+    ])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["affine-fusion", "-o", out, "--masks",
+                            "--blockScale", "1,1,1"])
+    assert r.exit_code == 0, r.output
+    store = ChunkStore.open(out)
+    meta = read_container_meta(store)
+    m = store.open_dataset("ch0tp0/s0").read_full()
+    assert set(np.unique(m)) <= {0, 255}
+    assert (m == 255).mean() > 0.8
+
+
+def test_dry_run_writes_nothing(tmp_path):
+    proj = make_synthetic_project(str(tmp_path / "p"), n_tiles=(1, 1, 1))
+    out = str(tmp_path / "dry.n5")
+    runner = CliRunner()
+    r = runner.invoke(cli, [
+        "create-fusion-container", "-x", proj.xml_path, "-o", out, "--dryRun",
+    ])
+    assert r.exit_code == 0, r.output
+    assert not os.path.exists(out)

@@ -1,0 +1,265 @@
+"""Fusion kernel: golden checks against an independent numpy resampler, and
+end-to-end fusion of the synthetic project against the known global phantom."""
+
+import numpy as np
+import pytest
+
+from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+from bigstitcher_spark_tpu.io.container import (
+    create_fusion_container,
+    estimate_multires_pyramid,
+    read_container_meta,
+)
+from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+from bigstitcher_spark_tpu.io.spimdata import SpimData, ViewId
+from bigstitcher_spark_tpu.models.affine_fusion import (
+    BlendParams,
+    fuse_volume,
+)
+from bigstitcher_spark_tpu.ops import fusion as F
+from bigstitcher_spark_tpu.utils.geometry import Interval
+from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+
+def np_trilinear(patch, pts):
+    """Independent trilinear reference."""
+    out = np.zeros(len(pts))
+    for i, p in enumerate(pts):
+        p0 = np.floor(p).astype(int)
+        f = p - p0
+        acc = 0.0
+        for dx in (0, 1):
+            for dy in (0, 1):
+                for dz in (0, 1):
+                    xi = np.clip(p0[0] + dx, 0, patch.shape[0] - 1)
+                    yi = np.clip(p0[1] + dy, 0, patch.shape[1] - 1)
+                    zi = np.clip(p0[2] + dz, 0, patch.shape[2] - 1)
+                    w = (
+                        (f[0] if dx else 1 - f[0])
+                        * (f[1] if dy else 1 - f[1])
+                        * (f[2] if dz else 1 - f[2])
+                    )
+                    acc += w * patch[xi, yi, zi]
+        out[i] = acc
+    return out
+
+
+def _identity_inputs(patch, v=1):
+    vb = F.bucket_views(v)
+    shape = patch.shape
+    patches = np.zeros((vb, *shape), np.float32)
+    patches[0] = patch
+    affines = np.zeros((vb, 3, 4), np.float32)
+    affines[:, :, :3] = np.eye(3)
+    offsets = np.zeros((vb, 3), np.float32)
+    img_dims = np.tile(np.array(shape, np.float32), (vb, 1))
+    borders = np.zeros((vb, 3), np.float32)
+    ranges = np.ones((vb, 3), np.float32)
+    valid = np.zeros((vb,), np.float32)
+    valid[0] = 1
+    return patches, affines, offsets, img_dims, borders, ranges, valid
+
+
+class TestKernel:
+    def test_identity_avg(self):
+        rng = np.random.default_rng(0)
+        patch = rng.uniform(0, 100, (8, 8, 8)).astype(np.float32)
+        args = _identity_inputs(patch)
+        fused, wsum = F.fuse_block(*args, block_shape=(8, 8, 8), fusion_type="AVG")
+        np.testing.assert_allclose(np.asarray(fused), patch, rtol=1e-5)
+        assert np.all(np.asarray(wsum) == 1.0)
+
+    def test_subpixel_translation_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        patch = rng.uniform(0, 100, (10, 9, 8)).astype(np.float32)
+        args = list(_identity_inputs(patch))
+        shift = np.array([0.5, 0.25, 0.75], np.float32)
+        args[1][0, :, 3] = shift  # affine translation
+        fused, _ = F.fuse_block(*args, block_shape=(6, 6, 6), fusion_type="AVG")
+        coords = np.stack(
+            np.meshgrid(*[np.arange(6)] * 3, indexing="ij"), -1
+        ).reshape(-1, 3)
+        expected = np_trilinear(patch, coords + shift).reshape(6, 6, 6)
+        np.testing.assert_allclose(np.asarray(fused), expected, rtol=1e-4)
+
+    def test_outside_is_masked(self):
+        patch = np.ones((8, 8, 8), np.float32) * 50
+        args = list(_identity_inputs(patch))
+        args[1][0, :, 3] = [-4, 0, 0]  # half the block samples before image start
+        fused, wsum = F.fuse_block(*args, block_shape=(8, 8, 8), fusion_type="AVG")
+        wsum = np.asarray(wsum)
+        assert np.all(wsum[:4] == 0)  # x<4 maps to lpos<0
+        assert np.all(wsum[4:] == 1)
+        assert np.all(np.asarray(fused)[:4] == 0)
+
+    def test_blend_weight_ramp(self):
+        # single view, blending: weight must rise cosine-like from the border
+        patch = np.ones((16, 16, 16), np.float32)
+        args = list(_identity_inputs(patch))
+        args[5] = np.full((1, 3), 4.0, np.float32)  # blend range 4
+        fused, wsum = F.fuse_block(
+            *args, block_shape=(16, 16, 16), fusion_type="AVG_BLEND"
+        )
+        w = np.asarray(wsum)[:, 8, 8]
+        assert w[0] == pytest.approx(0.0, abs=1e-6)  # at border
+        assert w[2] == pytest.approx(0.5 * (np.cos(0.5 * np.pi) + 1), rel=1e-4)
+        assert w[8] == pytest.approx(1.0)
+        # two-sided product in the corner
+        wc = np.asarray(wsum)[2, 2, 8]
+        assert wc == pytest.approx(w[2] * w[2], rel=1e-4)
+
+    def test_two_view_avg_blend_smooth(self):
+        # two constant views of different value overlapping: AVG_BLEND must
+        # interpolate smoothly between 10 and 30 along x
+        v = 2
+        vb = F.bucket_views(v)
+        shape = (32, 8, 8)
+        patches = np.zeros((vb, *shape), np.float32)
+        patches[0] = 10.0
+        patches[1] = 30.0
+        affines = np.zeros((vb, 3, 4), np.float32)
+        affines[:, :, :3] = np.eye(3)
+        affines[1, 0, 3] = -16.0  # view B starts at x=16 in block coords
+        offsets = np.zeros((vb, 3), np.float32)
+        img_dims = np.tile(np.array(shape, np.float32), (vb, 1))
+        borders = np.zeros((vb, 3), np.float32)
+        ranges = np.full((vb, 3), 8.0, np.float32)
+        ranges[:, 1:] = 0.001  # only blend along x
+        valid = np.array([1, 1] + [0] * (vb - 2), np.float32)
+        fused, wsum = F.fuse_block(
+            patches, affines, offsets, img_dims, borders, ranges, valid,
+            block_shape=(48, 8, 8), fusion_type="AVG_BLEND",
+        )
+        line = np.asarray(fused)[:, 4, 4]
+        assert line[8] == pytest.approx(10.0, rel=1e-4)   # only view A
+        assert line[40] == pytest.approx(30.0, rel=1e-4)  # only view B
+        mid = line[16:31]
+        assert np.all(np.diff(mid) >= -1e-4)  # monotone transition
+        assert line[23] == pytest.approx(20.0, abs=2.0)   # near middle
+
+    def test_max_and_wins(self):
+        vb = 2
+        patches = np.zeros((vb, 4, 4, 4), np.float32)
+        patches[0] = 5
+        patches[1] = 9
+        affines = np.zeros((vb, 3, 4), np.float32)
+        affines[:, :, :3] = np.eye(3)
+        offsets = np.zeros((vb, 3), np.float32)
+        img_dims = np.full((vb, 3), 4.0, np.float32)
+        borders = np.zeros((vb, 3), np.float32)
+        ranges = np.ones((vb, 3), np.float32)
+        valid = np.ones((vb,), np.float32)
+        a = (patches, affines, offsets, img_dims, borders, ranges, valid)
+        fused, _ = F.fuse_block(*a, block_shape=(4, 4, 4), fusion_type="MAX_INTENSITY")
+        assert np.all(np.asarray(fused) == 9)
+        fused, _ = F.fuse_block(*a, block_shape=(4, 4, 4), fusion_type="FIRST_WINS")
+        assert np.all(np.asarray(fused) == 5)
+        fused, _ = F.fuse_block(*a, block_shape=(4, 4, 4), fusion_type="LAST_WINS")
+        assert np.all(np.asarray(fused) == 9)
+
+    def test_convert_intensity(self):
+        block = np.array([0.0, 0.5, 1.0, 2.0], np.float32)
+        out = np.asarray(
+            F.convert_intensity(block, np.float32(0), np.float32(1), out_dtype="uint8")
+        )
+        np.testing.assert_array_equal(out, [0, 128, 255, 255])
+        out16 = np.asarray(
+            F.convert_intensity(block, np.float32(0), np.float32(2), out_dtype="uint16")
+        )
+        np.testing.assert_array_equal(out16, [0, 16384, 32768, 65535])
+
+
+class TestPyramidProposal:
+    def test_estimate(self):
+        ds = estimate_multires_pyramid((512, 512, 128))
+        assert ds[0] == [1, 1, 1]
+        assert ds[1] == [2, 2, 2]
+        assert all(len(d) == 3 for d in ds)
+        # small volume -> single level
+        assert estimate_multires_pyramid((32, 32, 16)) == [[1, 1, 1]]
+
+
+class TestEndToEnd:
+    def test_container_roundtrip(self, tmp_path):
+        bbox = Interval((0, 0, 0), (99, 89, 49))
+        meta = create_fusion_container(
+            str(tmp_path / "fused.n5"), StorageFormat.N5, "in.xml",
+            num_timepoints=2, num_channels=3, bbox=bbox,
+            data_type="uint16", block_size=(32, 32, 16),
+            downsamplings=[[1, 1, 1], [2, 2, 1]],
+        )
+        store = ChunkStore.open(str(tmp_path / "fused.n5"))
+        back = read_container_meta(store)
+        assert back.fusion_format == "N5"
+        assert back.bbox == bbox
+        assert back.num_channels == 3 and back.num_timepoints == 2
+        assert len(back.mr_infos) == 6
+        assert back.mr_infos[0][1].dataset == "ch0tp0/s1"
+        assert back.mr_infos[0][1].absoluteDownsampling == [2, 2, 1]
+        assert store.is_dataset("ch2tp1/s0")
+
+    def test_fuse_two_tiles_matches_phantom(self, tmp_path):
+        # jitter=0: XML offsets == true offsets, so fusion must reproduce
+        # the global phantom (up to per-tile noise) in the fused volume.
+        proj = make_synthetic_project(
+            str(tmp_path / "p"), n_tiles=(2, 1, 1), jitter=0.0, seed=3,
+        )
+        sd = SpimData.load(proj.xml_path)
+        loader = ViewLoader(sd)
+        views = sd.view_ids()
+        # bounding box = union of transformed views
+        from bigstitcher_spark_tpu.utils.geometry import transformed_interval
+
+        boxes = [
+            transformed_interval(sd.model(v), Interval.from_shape(sd.view_size(v)))
+            for v in views
+        ]
+        bbox = boxes[0]
+        for b in boxes[1:]:
+            bbox = bbox.union(b)
+        out = ChunkStore.create(str(tmp_path / "fused.n5"), StorageFormat.N5)
+        ds = out.create_dataset("fused/s0", bbox.shape, (64, 64, 32), "float32")
+        stats = fuse_volume(
+            sd, loader, views, ds, bbox, block_size=(64, 64, 32),
+            block_scale=(1, 1, 1), fusion_type="AVG_BLEND",
+            out_dtype="float32", min_intensity=0, max_intensity=1,
+        )
+        assert stats.voxels == bbox.num_elements
+        fused = ds.read_full()
+        # compare at bead positions that are strictly inside the fused volume
+        from bigstitcher_spark_tpu.utils.testdata import make_bead_volume
+
+        assert fused.max() > 500
+        # interior means: global average intensity close between fused & tiles
+        t0 = loader.open(ViewId(0, 0)).read_full().astype(np.float32)
+        inner = fused[8:88, 8:88, 8:40]
+        assert abs(float(np.median(inner)) - float(np.median(t0))) < 5.0
+        # coverage: every voxel inside the union box that belongs to some view
+        assert float((fused == 0).mean()) < 0.15
+
+    def test_fuse_into_zarr5d(self, tmp_path):
+        proj = make_synthetic_project(
+            str(tmp_path / "p"), n_tiles=(1, 1, 1), jitter=0.0, seed=4,
+        )
+        sd = SpimData.load(proj.xml_path)
+        loader = ViewLoader(sd)
+        bbox = Interval.from_shape(sd.view_size(ViewId(0, 0)))
+        meta = create_fusion_container(
+            str(tmp_path / "f.zarr"), StorageFormat.ZARR, proj.xml_path,
+            num_timepoints=1, num_channels=1, bbox=bbox, data_type="uint16",
+            block_size=(48, 48, 24),
+        )
+        store = ChunkStore.open(str(tmp_path / "f.zarr"))
+        ds = store.open_dataset("0")
+        stats = fuse_volume(
+            sd, loader, sd.view_ids(), ds, bbox, block_size=(48, 48, 24),
+            block_scale=(1, 1, 1), out_dtype="uint16",
+            min_intensity=0.0, max_intensity=65535.0, zarr_ct=(0, 0),
+        )
+        fused = ds.read((0, 0, 0, 0, 0), (*bbox.shape, 1, 1))[..., 0, 0]
+        src = loader.open(ViewId(0, 0)).read_full()
+        # single view, identity transform, no blending at interior: exact match
+        inner = (slice(45, 50), slice(45, 50), slice(20, 28))
+        np.testing.assert_allclose(
+            fused[inner].astype(float), src[inner].astype(float), atol=1.0
+        )
