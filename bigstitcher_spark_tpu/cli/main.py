@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import click
 
-from . import fusion_tools
+from . import fusion_tools, resave_tools
 
 
 @click.group()
@@ -19,6 +19,8 @@ def cli():
 
 cli.add_command(fusion_tools.create_fusion_container_cmd, "create-fusion-container")
 cli.add_command(fusion_tools.affine_fusion_cmd, "affine-fusion")
+cli.add_command(resave_tools.resave_cmd, "resave")
+cli.add_command(resave_tools.downsample_cmd, "downsample")
 
 
 def register(module_names: list[str]) -> None:

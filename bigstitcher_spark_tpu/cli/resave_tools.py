@@ -1,0 +1,161 @@
+"""``resave`` and ``downsample`` commands.
+
+Reference tools: SparkResaveN5.java (re-save any dataset into chunked
+N5/OME-ZARR + pyramid, rewiring the XML) and SparkDownsample.java
+(distributed pyramid creation for an existing dataset). Flag names follow
+the reference CLI surface (SparkResaveN5.java:80-104,
+SparkDownsample.java:60-76).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+import click
+import numpy as np
+
+from ..io.chunkstore import ChunkStore, StorageFormat
+from ..io.dataset_io import ViewLoader
+from ..io.spimdata import SpimData
+from ..models.downsample_driver import downsample_write_block
+from ..models.resave import propose_pyramid, resave, swap_imgloader
+from ..parallel.retry import run_with_retry
+from ..utils.grid import create_grid
+from .common import (
+    infrastructure_options,
+    parse_csv_ints,
+    select_views_from_kwargs,
+    view_selection_options,
+    xml_option,
+)
+
+
+def parse_pyramid(spec_list) -> list[list[int]] | None:
+    """Parse ``-ds 1,1,1 -ds 2,2,1`` or a single ``'1,1,1; 2,2,1'`` string
+    (reference ';'-separated pyramid specs, Import.java:261-287)."""
+    if not spec_list:
+        return None
+    parts: list[str] = []
+    for s in spec_list:
+        parts.extend(p for p in s.split(";") if p.strip())
+    return [parse_csv_ints(p.strip(), 3) for p in parts]
+
+
+@click.command()
+@xml_option
+@view_selection_options
+@infrastructure_options
+@click.option("-xo", "--xmlout", "xml_out", default=None,
+              help="output XML path (default: overwrite input, keep ~1 backup)")
+@click.option("-o", "--n5Path", "out_path", default=None,
+              help="container path (default: '<xml folder>/dataset-resaved"
+                   ".n5|.zarr')")
+@click.option("--N5", "as_n5", is_flag=True, default=False,
+              help="export as N5 (default: ZARR)")
+@click.option("--blockSize", "block_size", default="128,128,64")
+@click.option("--blockScale", "block_scale", default="16,16,1",
+              help="how many blocks one processing step writes")
+@click.option("-ds", "--downsampling", "downsampling", multiple=True,
+              help="pyramid steps incl. 1,1,1, e.g. '1,1,1; 2,2,1; 4,4,1'")
+@click.option("-c", "--compression", default="zstd",
+              type=click.Choice(["zstd", "gzip", "raw", "blosc"]))
+@click.option("--threads", type=int, default=8,
+              help="host IO threads for block copy")
+def resave_cmd(xml, xml_out, out_path, as_n5, block_size, block_scale,
+               downsampling, compression, threads, dry_run, **kwargs):
+    """Re-save the project into a chunked multi-res container."""
+    sd = SpimData.load(xml)
+    loader = ViewLoader(sd)
+    views = select_views_from_kwargs(sd, kwargs)
+    storage_format = StorageFormat.N5 if as_n5 else StorageFormat.ZARR
+    if out_path is None:
+        ext = "n5" if as_n5 else "zarr"
+        out_path = os.path.join(os.path.dirname(os.path.abspath(xml)),
+                                f"dataset-resaved.{ext}")
+    ds = parse_pyramid(downsampling) or propose_pyramid(sd, views)
+    click.echo(f"resaving {len(views)} views -> {out_path} ({storage_format.value})")
+    click.echo(f"pyramid: {ds}")
+    if dry_run:
+        click.echo("(dry run, not writing)")
+        return
+    stats = resave(
+        sd, loader, views, out_path, storage_format,
+        block_size=tuple(parse_csv_ints(block_size, 3)),
+        block_scale=tuple(parse_csv_ints(block_scale, 3)),
+        downsamplings=ds, compression=compression, threads=threads,
+    )
+    swap_imgloader(sd, os.path.abspath(out_path), storage_format)
+    target = xml_out or xml
+    if xml_out is None and os.path.exists(xml):
+        shutil.copy2(xml, xml + "~1")  # reference keeps a ~1 backup
+    sd.save(target)
+    click.echo(
+        f"resaved {stats.views} views ({stats.s0_blocks} s0 + "
+        f"{stats.pyramid_blocks} pyramid blocks) in {stats.seconds:.1f}s; "
+        f"XML -> {target}"
+    )
+
+
+@click.command()
+@infrastructure_options
+@click.option("-i", "--n5PathIn", "path_in", required=True,
+              help="container path, e.g. /home/fused.n5")
+@click.option("-di", "--n5DatasetIn", "dataset_in", required=True,
+              help="input dataset, e.g. /ch488/s0")
+@click.option("-do", "--n5DatasetsOut", "datasets_out", default=None,
+              help="output dataset(s), ';'-separated, e.g. /ch488/s1;/ch488/s2")
+@click.option("-ds", "--downsampling", "downsampling", required=True,
+              help="consecutive steps, ';'-separated, e.g. '2,2,1; 2,2,1; 2,2,2'")
+@click.option("--blockScale", "block_scale", default="1,1,1")
+@click.option("--threads", type=int, default=8)
+def downsample_cmd(path_in, dataset_in, datasets_out, downsampling,
+                   block_scale, threads, dry_run):
+    """Chained 2x downsampling of an existing dataset (pyramid levels)."""
+    store = ChunkStore.open(path_in)
+    src_path = dataset_in.strip("/")
+    steps = parse_pyramid([downsampling])
+    if datasets_out:
+        outs = [p.strip().strip("/") for p in datasets_out.split(";") if p.strip()]
+    else:
+        # default: s{N} siblings of the input (reference requires -do; we
+        # derive it when the input ends in s{N})
+        base, name = os.path.split(src_path)
+        if not (name.startswith("s") and name[1:].isdigit()):
+            raise click.ClickException("-do required unless input ends in /s<N>")
+        n0 = int(name[1:])
+        outs = [f"{base}/s{n0 + i + 1}".strip("/") for i in range(len(steps))]
+    if len(outs) != len(steps):
+        raise click.ClickException(
+            f"{len(outs)} output datasets but {len(steps)} downsampling steps"
+        )
+
+    src = store.open_dataset(src_path)
+    bscale = parse_csv_ints(block_scale, 3)
+    click.echo(f"downsampling {src_path} {src.shape} by {steps} -> {outs}")
+    if dry_run:
+        return
+
+    prev = src
+    # absolute factors continue from the input level's own factors so
+    # best_mipmap_level / mipmap transforms stay correct when starting at s>0
+    abs_factor = [int(v) for v in
+                  (store.get_attribute(src_path, "downsamplingFactors")
+                   or [1, 1, 1])]
+    for step, out_path in zip(steps, outs):
+        abs_factor = [a * f for a, f in zip(abs_factor, step)]
+        dims = [max(1, s // f) for s, f in zip(prev.shape, step)]
+        dst = store.create_dataset(out_path, dims, prev.block_size,
+                                   prev.dtype.name, delete_existing=True)
+        store.set_attribute(out_path, "downsamplingFactors",
+                            [int(v) for v in abs_factor])
+        compute_block = [b * s for b, s in zip(dst.block_size, bscale)]
+        grid = create_grid(dims, compute_block, dst.block_size)
+
+        def process(blk, src_ds=prev, dst_ds=dst, f=tuple(step)):
+            downsample_write_block(src_ds, dst_ds, blk, f)
+
+        run_with_retry(grid, process, label=f"downsample block ({out_path})",
+                       threads=threads)
+        click.echo(f"  wrote {out_path} {tuple(dims)}")
+        prev = dst

@@ -6,11 +6,53 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..io.chunkstore import ChunkStore
+from ..io.chunkstore import ChunkStore, Dataset
 from ..io.container import MultiResolutionLevelInfo
 from ..ops.downsample import downsample_block
 from ..parallel.retry import run_with_retry
-from ..utils.grid import create_grid
+from ..utils.grid import GridBlock, create_grid
+
+
+def downsample_read(src_read, src_shape, src_off, src_size, factors) -> "np.ndarray":
+    """Read ``src_size`` voxels at ``src_off``, edge-replicating past the
+    source extent (thin axes whose level dim was clamped to 1), then
+    average-downsample by ``factors``. ``src_read(off, size)`` is the raw
+    reader."""
+    clamped = [min(int(s), int(e) - int(o)) for s, e, o in
+               zip(src_size, src_shape, src_off)]
+    data = src_read([int(o) for o in src_off], clamped)
+    if clamped != [int(s) for s in src_size]:
+        pad = [(0, int(s) - c) for s, c in zip(src_size, clamped)]
+        data = np.pad(data, pad, mode="edge")
+    return np.asarray(downsample_block(data, tuple(int(f) for f in factors)))
+
+
+def downsample_write_block(src: Dataset, dst: Dataset, block: GridBlock,
+                           factors, src_read=None) -> None:
+    """The shared per-block downsample step: read factor-scaled source box,
+    average, clip/round for integer outputs, write (used by the fusion
+    pyramid, resave pyramid, and the standalone downsample tool)."""
+    src_off = [o * f for o, f in zip(block.offset, factors)]
+    src_size = [s * f for s, f in zip(block.size, factors)]
+    out = downsample_read(src_read or src.read, src.shape, src_off, src_size,
+                          factors)
+    if np.issubdtype(dst.dtype, np.integer):
+        info = np.iinfo(dst.dtype)
+        out = np.clip(np.round(out), info.min, info.max)
+    dst.write(out.astype(dst.dtype), block.offset)
+
+
+def validate_pyramid(absolute: list[list[int]]) -> None:
+    """Each absolute factor must be an exact multiple of the previous one,
+    starting at 1,1,1 — otherwise relative steps floor-divide and levels
+    would be silently corrupt."""
+    if list(absolute[0]) != [1, 1, 1]:
+        raise ValueError(f"pyramid must start with 1,1,1, got {absolute[0]}")
+    for prev, cur in zip(absolute, absolute[1:]):
+        if any(int(c) % int(p) != 0 for p, c in zip(prev, cur)):
+            raise ValueError(
+                f"pyramid step {cur} is not an exact multiple of {prev}"
+            )
 
 
 def downsample_pyramid_level(
@@ -28,22 +70,23 @@ def downsample_pyramid_level(
     block3 = [int(v) for v in dst_info.blockSize[:3]]
     grid = create_grid(dims3, block3)
 
-    def process(block):
-        src_off = [o * f for o, f in zip(block.offset, rel)]
-        src_size = [s * f for s, f in zip(block.size, rel)]
-        if is_zarr5d:
-            c, t = ct
-            data = src.read((*src_off, c, t), (*src_size, 1, 1))[..., 0, 0]
-        else:
-            data = src.read(src_off, src_size)
-        out = np.asarray(downsample_block(data, tuple(rel)))
-        if np.issubdtype(dst.dtype, np.integer):
-            out = np.clip(np.round(out), np.iinfo(dst.dtype).min,
-                          np.iinfo(dst.dtype).max)
-        out = out.astype(dst.dtype)
-        if is_zarr5d:
-            dst.write(out[..., None, None], (*block.offset, *ct))
-        else:
-            dst.write(out, block.offset)
+    if is_zarr5d:
+        c, t = ct
+
+        def read3d(off, size):
+            return src.read((*off, c, t), (*size, 1, 1))[..., 0, 0]
+
+        def process(block):
+            out = downsample_read(read3d, src.shape[:3],
+                                  [o * f for o, f in zip(block.offset, rel)],
+                                  [s * f for s, f in zip(block.size, rel)], rel)
+            if np.issubdtype(dst.dtype, np.integer):
+                info = np.iinfo(dst.dtype)
+                out = np.clip(np.round(out), info.min, info.max)
+            dst.write(out.astype(dst.dtype)[..., None, None],
+                      (*block.offset, *ct))
+    else:
+        def process(block):
+            downsample_write_block(src, dst, block, rel)
 
     run_with_retry(grid, process, label="downsample block")

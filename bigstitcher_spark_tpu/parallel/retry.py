@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import time
 import traceback
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, Sequence, TypeVar
 
 T = TypeVar("T")
@@ -26,20 +27,32 @@ def run_with_retry(
     delay_s: float = 2.0,
     label: str = "block",
     verbose: bool = True,
+    threads: int = 1,
 ) -> int:
     """Process all items; collect failures and resubmit only those.
 
-    Returns the number of retry rounds used. Raises RetryError when items
-    still fail after ``max_retries`` rounds (reference exits the JVM)."""
+    ``threads > 1`` runs items on a host thread pool — safe for IO-bound
+    chunk copy work (tensorstore releases the GIL; writers own disjoint
+    chunks by construction). Returns the number of retry rounds used. Raises
+    RetryError when items still fail after ``max_retries`` rounds (reference
+    exits the JVM)."""
     pending: list[T] = list(items)
     rounds = 0
     while pending:
         failed: list[tuple[T, Exception]] = []
-        for it in pending:
+
+        def attempt(it: T):
             try:
                 process(it)
+                return None
             except Exception as e:  # noqa: BLE001 - any task failure is retryable
-                failed.append((it, e))
+                return (it, e)
+
+        if threads > 1:
+            with ThreadPoolExecutor(max_workers=threads) as pool:
+                failed = [r for r in pool.map(attempt, pending) if r is not None]
+        else:
+            failed = [r for r in map(attempt, pending) if r is not None]
         if not failed:
             return rounds
         rounds += 1

@@ -1,0 +1,137 @@
+"""resave + downsample tools (reference: SparkResaveN5, SparkDownsample;
+test model follows the reference's CLI-level end-to-end pattern,
+TestSparkResave.java:30-38, on the synthetic fixture)."""
+
+import os
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from bigstitcher_spark_tpu.cli.main import cli
+from bigstitcher_spark_tpu.io.chunkstore import ChunkStore
+from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+from bigstitcher_spark_tpu.io.spimdata import SpimData, ViewId
+
+
+def test_resave_cli_roundtrip(synthetic_project, tmp_path):
+    proj = synthetic_project
+    out = str(tmp_path / "resaved.n5")
+    xml_out = str(tmp_path / "resaved.xml")
+    runner = CliRunner()
+    res = runner.invoke(cli, [
+        "resave", "-x", proj.xml_path, "-xo", xml_out, "-o", out, "--N5",
+        "--blockSize", "32,32,16", "-ds", "1,1,1; 2,2,1",
+        "--threads", "2",
+    ], catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+
+    # new project points at the new container and images round-trip
+    sd2 = SpimData.load(xml_out)
+    assert sd2.resolve_loader_path() == out
+    loader2 = ViewLoader(sd2)
+    sd1 = SpimData.load(proj.xml_path)
+    loader1 = ViewLoader(sd1)
+    for v in sd1.view_ids():
+        a = loader1.open(v, 0).read_full()
+        b = loader2.open(v, 0).read_full()
+        np.testing.assert_array_equal(a, b)
+        # level 1 = 2,2,1 average of level 0
+        lvl1 = loader2.open(v, 1).read_full()
+        assert lvl1.shape == (a.shape[0] // 2, a.shape[1] // 2, a.shape[2])
+    # registrations survive
+    assert sd2.registrations.keys() == sd1.registrations.keys()
+
+
+def test_resave_auto_pyramid(synthetic_project, tmp_path):
+    from bigstitcher_spark_tpu.models.resave import propose_pyramid
+
+    sd = SpimData.load(synthetic_project.xml_path)
+    pyr = propose_pyramid(sd, sd.view_ids())
+    assert pyr[0] == [1, 1, 1]
+    assert len(pyr) >= 2  # 96x96x48 tiles halve at least once
+    for prev, cur in zip(pyr, pyr[1:]):
+        assert all(c % p == 0 for p, c in zip(prev, cur))
+
+
+def test_resave_rejects_non_divisible_pyramid(synthetic_project, tmp_path):
+    runner = CliRunner()
+    res = runner.invoke(cli, [
+        "resave", "-x", synthetic_project.xml_path,
+        "-xo", str(tmp_path / "o.xml"), "-o", str(tmp_path / "o.n5"), "--N5",
+        "-ds", "1,1,1; 2,2,1; 3,3,1",
+    ])
+    assert res.exit_code != 0
+    assert "not an exact multiple" in str(res.exception)
+
+
+def test_downsample_thin_axis_clamped_level(tmp_path):
+    """A level dim clamped to 1 must edge-replicate, not crash
+    (downsample_read pads past the source extent)."""
+    from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+    from bigstitcher_spark_tpu.models.downsample_driver import (
+        downsample_write_block,
+    )
+    from bigstitcher_spark_tpu.utils.grid import create_grid
+
+    store = ChunkStore.create(str(tmp_path / "t.n5"), StorageFormat.N5)
+    src = store.create_dataset("s0", (8, 8, 1), (8, 8, 1), "uint16")
+    src.write(np.arange(64, dtype=np.uint16).reshape(8, 8, 1), (0, 0, 0))
+    dims = [max(1, s // 2) for s in src.shape]  # z floors to 0 -> clamped to 1
+    dst = store.create_dataset("s1", dims, (8, 8, 1), "uint16")
+    for blk in create_grid(dims, dims):
+        downsample_write_block(src, dst, blk, (2, 2, 2))
+    out = dst.read_full()
+    exp = np.arange(64).reshape(8, 8).astype(np.float64)
+    exp = 0.25 * (exp[0::2, 0::2] + exp[1::2, 0::2] + exp[0::2, 1::2]
+                  + exp[1::2, 1::2])
+    np.testing.assert_allclose(out[..., 0], np.round(exp), atol=1.0)
+
+
+def test_downsample_continues_absolute_factors(synthetic_project):
+    """Starting at s1 (factors 2,2,1 in a resaved project) must stamp
+    absolute, not relative, downsamplingFactors on new levels."""
+    import os
+
+    sd = SpimData.load(synthetic_project.xml_path)
+    container = sd.resolve_loader_path()
+    store = ChunkStore.open(container)
+    store.set_attribute("setup0/timepoint0/s0", "downsamplingFactors",
+                        [2, 2, 1])
+    runner = CliRunner()
+    res = runner.invoke(cli, [
+        "downsample", "-i", container, "-di", "setup0/timepoint0/s0",
+        "-ds", "2,2,2", "-do", "setup0/timepoint0/sx",
+    ], catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert store.get_attribute("setup0/timepoint0/sx", "downsamplingFactors") \
+        == [4, 4, 2]
+
+
+def test_downsample_cli(synthetic_project, tmp_path):
+    sd = SpimData.load(synthetic_project.xml_path)
+    container = sd.resolve_loader_path()
+    runner = CliRunner()
+    res = runner.invoke(cli, [
+        "downsample", "-i", container,
+        "-di", "setup0/timepoint0/s0",
+        "-ds", "2,2,1; 2,2,2",
+        "--threads", "2",
+    ], catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+
+    store = ChunkStore.open(container)
+    s0 = store.open_dataset("setup0/timepoint0/s0").read_full()
+    s1 = store.open_dataset("setup0/timepoint0/s1").read_full()
+    s2 = store.open_dataset("setup0/timepoint0/s2").read_full()
+    assert s1.shape == (s0.shape[0] // 2, s0.shape[1] // 2, s0.shape[2])
+    assert s2.shape == (s1.shape[0] // 2, s1.shape[1] // 2, s1.shape[2] // 2)
+    # numerics: pairwise averaging along x/y for s1
+    expected = s0.astype(np.float64)
+    expected = 0.5 * (expected[0::2] + expected[1::2])
+    expected = 0.5 * (expected[:, 0::2] + expected[:, 1::2])
+    np.testing.assert_allclose(
+        s1.astype(np.float64), np.round(expected), atol=1.0
+    )
+    assert store.get_attribute("setup0/timepoint0/s2", "downsamplingFactors") \
+        == [4, 4, 2]
