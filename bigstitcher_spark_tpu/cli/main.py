@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import click
 
-from . import fusion_tools, resave_tools
+from . import fusion_tools, resave_tools, stitching_tools
 
 
 @click.group()
@@ -21,6 +21,7 @@ cli.add_command(fusion_tools.create_fusion_container_cmd, "create-fusion-contain
 cli.add_command(fusion_tools.affine_fusion_cmd, "affine-fusion")
 cli.add_command(resave_tools.resave_cmd, "resave")
 cli.add_command(resave_tools.downsample_cmd, "downsample")
+cli.add_command(stitching_tools.stitching_cmd, "stitching")
 
 
 def register(module_names: list[str]) -> None:

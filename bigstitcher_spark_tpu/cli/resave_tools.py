@@ -18,7 +18,7 @@ import numpy as np
 from ..io.chunkstore import ChunkStore, StorageFormat
 from ..io.dataset_io import ViewLoader
 from ..io.spimdata import SpimData
-from ..models.downsample_driver import downsample_write_block
+from ..models.downsample_driver import downsample_write_block, validate_pyramid
 from ..models.resave import propose_pyramid, resave, swap_imgloader
 from ..parallel.retry import run_with_retry
 from ..utils.grid import create_grid
@@ -74,6 +74,9 @@ def resave_cmd(xml, xml_out, out_path, as_n5, block_size, block_scale,
         out_path = os.path.join(os.path.dirname(os.path.abspath(xml)),
                                 f"dataset-resaved.{ext}")
     ds = parse_pyramid(downsampling) or propose_pyramid(sd, views)
+    validate_pyramid(ds)  # preflight so --dryRun catches bad pyramids too
+    bs = tuple(parse_csv_ints(block_size, 3))
+    bsc = tuple(parse_csv_ints(block_scale, 3))
     click.echo(f"resaving {len(views)} views -> {out_path} ({storage_format.value})")
     click.echo(f"pyramid: {ds}")
     if dry_run:
@@ -81,8 +84,7 @@ def resave_cmd(xml, xml_out, out_path, as_n5, block_size, block_scale,
         return
     stats = resave(
         sd, loader, views, out_path, storage_format,
-        block_size=tuple(parse_csv_ints(block_size, 3)),
-        block_scale=tuple(parse_csv_ints(block_scale, 3)),
+        block_size=bs, block_scale=bsc,
         downsamplings=ds, compression=compression, threads=threads,
     )
     swap_imgloader(sd, os.path.abspath(out_path), storage_format)
@@ -131,6 +133,12 @@ def downsample_cmd(path_in, dataset_in, datasets_out, downsampling,
         )
 
     src = store.open_dataset(src_path)
+    if len(src.shape) != 3:
+        raise click.ClickException(
+            f"{src_path} is {len(src.shape)}-D; this tool handles 3-D "
+            "datasets (5-D OME-ZARR fusion pyramids are written by "
+            "affine-fusion itself)"
+        )
     bscale = parse_csv_ints(block_scale, 3)
     click.echo(f"downsampling {src_path} {src.shape} by {steps} -> {outs}")
     if dry_run:
@@ -159,3 +167,26 @@ def downsample_cmd(path_in, dataset_in, datasets_out, downsampling,
                        threads=threads)
         click.echo(f"  wrote {out_path} {tuple(dims)}")
         prev = dst
+
+    # BDV layout (setup{S}/timepoint{T}/s{N}): extend the setup-level factor
+    # list so ViewLoader/best_mipmap_level can discover the new levels
+    parts = src_path.split("/")
+    if (len(parts) == 3 and parts[0].startswith("setup")
+            and all(p.strip("/").split("/")[0] == parts[0]
+                    and len(p.strip("/").split("/")) == 3 for p in outs)):
+        setup_group = parts[0]
+        existing = store.get_attribute(setup_group, "downsamplingFactors") or []
+        known = {tuple(int(v) for v in f) for f in existing}
+        added = []
+        af = [int(v) for v in
+              (store.get_attribute(src_path, "downsamplingFactors")
+               or [1, 1, 1])]
+        for step in steps:
+            af = [a * f for a, f in zip(af, step)]
+            if tuple(af) not in known:
+                existing.append(list(af))
+                added.append(list(af))
+        if added:
+            store.set_attribute(setup_group, "downsamplingFactors", existing)
+            store.set_attribute(f"{setup_group}/{parts[1]}", "multiScale", True)
+            click.echo(f"  registered factors {added} on {setup_group}")

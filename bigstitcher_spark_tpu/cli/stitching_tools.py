@@ -1,0 +1,87 @@
+"""``stitching`` command (SparkPairwiseStitching equivalent).
+
+Distributed FFT phase-correlation translation estimation for every
+overlapping tile pair; results (+ registration hash) land in the XML's
+StitchingResults section for the solver. Flags mirror the reference
+(SparkPairwiseStitching.java:76-106).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import click
+
+from ..io.dataset_io import ViewLoader
+from ..io.spimdata import SpimData
+from ..models.stitching import (
+    StitchingParams,
+    filter_results,
+    stitch_all_pairs,
+    store_results,
+)
+from .common import (
+    infrastructure_options,
+    parse_csv_ints,
+    select_views_from_kwargs,
+    view_selection_options,
+    xml_option,
+)
+
+
+@click.command()
+@xml_option
+@view_selection_options
+@infrastructure_options
+@click.option("-ds", "--downsampling", "downsampling", default="2,2,1",
+              help="downsampling for the correlation, e.g. 4,4,1")
+@click.option("-p", "--peaksToCheck", "peaks", type=int, default=5,
+              help="phase-correlation peaks to verify by cross-correlation")
+@click.option("--disableSubpixelResolution", "no_subpixel", is_flag=True,
+              default=False)
+@click.option("--minR", "min_r", type=float, default=0.3,
+              help="minimum required cross correlation")
+@click.option("--maxR", "max_r", type=float, default=1.0)
+@click.option("--maxShiftX", "max_shift_x", type=float, default=None)
+@click.option("--maxShiftY", "max_shift_y", type=float, default=None)
+@click.option("--maxShiftZ", "max_shift_z", type=float, default=None)
+@click.option("--maxShiftTotal", "max_shift_total", type=float, default=None)
+@click.option("--channelCombine", "channel_combine",
+              type=click.Choice(["AVERAGE", "PICK_BRIGHTEST"]),
+              default="AVERAGE")
+@click.option("--illumCombine", "illum_combine",
+              type=click.Choice(["AVERAGE", "PICK_BRIGHTEST"]),
+              default="PICK_BRIGHTEST")
+def stitching_cmd(xml, downsampling, peaks, no_subpixel, min_r, max_r,
+                  max_shift_x, max_shift_y, max_shift_z, max_shift_total,
+                  channel_combine, illum_combine, dry_run, **kwargs):
+    """Pairwise phase-correlation stitching of overlapping tiles."""
+    sd = SpimData.load(xml)
+    loader = ViewLoader(sd)
+    views = select_views_from_kwargs(sd, kwargs)
+
+    inf = float("inf")
+    params = StitchingParams(
+        downsampling=tuple(parse_csv_ints(downsampling, 3)),
+        peaks_to_check=peaks,
+        subpixel=not no_subpixel,
+        min_r=min_r, max_r=max_r,
+        max_shift=(max_shift_x if max_shift_x is not None else inf,
+                   max_shift_y if max_shift_y is not None else inf,
+                   max_shift_z if max_shift_z is not None else inf),
+        max_shift_total=(max_shift_total if max_shift_total is not None else inf),
+        channel_combine=channel_combine,
+        illum_combine=illum_combine,
+    )
+    results = stitch_all_pairs(sd, loader, views, params)
+    for res in results:
+        shift = res.transform[:, 3]
+        click.echo(f"  {res.views_a} <-> {res.views_b}: "
+                   f"shift={np.round(shift, 2)} r={res.correlation:.3f}")
+    kept = filter_results(results, params)
+    click.echo(f"{len(kept)}/{len(results)} pairs pass filters")
+    if dry_run:
+        click.echo("(dry run, not saving)")
+        return
+    store_results(sd, kept)
+    sd.save(xml)
+    click.echo(f"saved StitchingResults -> {xml}")

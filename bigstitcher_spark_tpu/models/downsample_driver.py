@@ -28,18 +28,21 @@ def downsample_read(src_read, src_shape, src_off, src_size, factors) -> "np.ndar
 
 
 def downsample_write_block(src: Dataset, dst: Dataset, block: GridBlock,
-                           factors, src_read=None) -> None:
+                           factors, src_read=None, src_shape=None,
+                           dst_write=None) -> None:
     """The shared per-block downsample step: read factor-scaled source box,
     average, clip/round for integer outputs, write (used by the fusion
-    pyramid, resave pyramid, and the standalone downsample tool)."""
+    pyramid, resave pyramid, and the standalone downsample tool).
+    ``src_read``/``src_shape``/``dst_write`` override the raw 3-D accessors
+    (the 5-D OME-ZARR path supplies channel/timepoint-sliced wrappers)."""
     src_off = [o * f for o, f in zip(block.offset, factors)]
     src_size = [s * f for s, f in zip(block.size, factors)]
-    out = downsample_read(src_read or src.read, src.shape, src_off, src_size,
-                          factors)
+    out = downsample_read(src_read or src.read,
+                          src_shape or src.shape, src_off, src_size, factors)
     if np.issubdtype(dst.dtype, np.integer):
         info = np.iinfo(dst.dtype)
         out = np.clip(np.round(out), info.min, info.max)
-    dst.write(out.astype(dst.dtype), block.offset)
+    (dst_write or dst.write)(out.astype(dst.dtype), block.offset)
 
 
 def validate_pyramid(absolute: list[list[int]]) -> None:
@@ -76,15 +79,12 @@ def downsample_pyramid_level(
         def read3d(off, size):
             return src.read((*off, c, t), (*size, 1, 1))[..., 0, 0]
 
+        def write3d(data, off):
+            dst.write(data[..., None, None], (*off, c, t))
+
         def process(block):
-            out = downsample_read(read3d, src.shape[:3],
-                                  [o * f for o, f in zip(block.offset, rel)],
-                                  [s * f for s, f in zip(block.size, rel)], rel)
-            if np.issubdtype(dst.dtype, np.integer):
-                info = np.iinfo(dst.dtype)
-                out = np.clip(np.round(out), info.min, info.max)
-            dst.write(out.astype(dst.dtype)[..., None, None],
-                      (*block.offset, *ct))
+            downsample_write_block(src, dst, block, rel, src_read=read3d,
+                                   src_shape=src.shape[:3], dst_write=write3d)
     else:
         def process(block):
             downsample_write_block(src, dst, block, rel)

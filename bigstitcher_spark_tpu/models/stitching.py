@@ -1,0 +1,370 @@
+"""Pairwise stitching driver: plan overlap pairs, extract + aggregate crops,
+run the batched phase-correlation kernel, filter, store results.
+
+TPU redesign of SparkPairwiseStitching (reference call stack SURVEY.md §3.2):
+the work list is the set of overlapping grouped-view pairs (strategy P2);
+pairs are bucketed by padded crop shape so one compiled kernel serves every
+pair in a bucket, then results are filtered (minR/maxShift) and written into
+the XML with a registration hash for solver staleness checks
+(SparkPairwiseStitching.java:287-299,347-382).
+
+Shift semantics (used by the solver): a stored result with shift S means the
+per-view correction translations must satisfy ``c_A - c_B = S`` — S is the
+world-space displacement by which group B's current render is offset against
+group A's (derivation in ``_stitch_one_bucket``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from ..io.dataset_io import ViewLoader, best_mipmap_level
+from ..io.spimdata import (
+    PairwiseStitchingResult,
+    SpimData,
+    ViewId,
+    registration_hash,
+)
+from ..ops.downsample import downsample_block
+from ..ops.phasecorr import pad_to, stitch_crops_batch
+from ..utils.geometry import (
+    Interval,
+    concatenate,
+    invert_affine,
+    transformed_interval,
+    translation_affine,
+)
+from .. import profiling
+
+
+@dataclass
+class StitchingParams:
+    """Defaults match the reference CLI (SparkPairwiseStitching.java:76-106)."""
+
+    downsampling: tuple[int, int, int] = (2, 2, 1)
+    peaks_to_check: int = 5
+    subpixel: bool = True
+    min_r: float = 0.3
+    max_r: float = 1.0
+    max_shift: tuple[float, float, float] = (np.inf, np.inf, np.inf)
+    max_shift_total: float = np.inf
+    channel_combine: str = "AVERAGE"        # AVERAGE | PICK_BRIGHTEST
+    illum_combine: str = "PICK_BRIGHTEST"   # AVERAGE | PICK_BRIGHTEST
+    min_overlap_px: int = 32
+    batch_size: int = 16
+
+
+@dataclass
+class ViewGroup:
+    """Views of one tile grouped over channel+illumination
+    (reference grouping: group {Channel, Illumination}, compare {Tile},
+    SparkPairwiseStitching.java:146-160)."""
+
+    timepoint: int
+    angle: int
+    tile: int
+    views: tuple[ViewId, ...]
+
+    @property
+    def key(self):
+        return (self.timepoint, self.angle, self.tile)
+
+
+def build_groups(sd: SpimData, views: list[ViewId]) -> list[ViewGroup]:
+    by_key: dict[tuple, list[ViewId]] = {}
+    for v in views:
+        s = sd.setups[v.setup]
+        key = (v.timepoint, s.attributes.get("angle", 0), s.attributes.get("tile", 0))
+        by_key.setdefault(key, []).append(v)
+    return [
+        ViewGroup(k[0], k[1], k[2], tuple(sorted(vs)))
+        for k, vs in sorted(by_key.items())
+    ]
+
+
+def group_bbox(sd: SpimData, g: ViewGroup) -> Interval:
+    """World-space bbox of a group (union over member views)."""
+    box = None
+    for v in g.views:
+        iv = transformed_interval(sd.model(v), Interval.from_shape(sd.view_size(v)))
+        box = iv if box is None else box.union(iv)
+    return box
+
+
+def plan_pairs(sd: SpimData, groups: list[ViewGroup]) -> list[tuple[ViewGroup, ViewGroup, Interval]]:
+    """All overlapping group pairs within one (timepoint, angle) slice
+    (compare {Tile}, apply over {TimePoint, Angle};
+    TransformationTools.filterNonOverlappingPairs role)."""
+    out = []
+    boxes = {g.key: group_bbox(sd, g) for g in groups}
+    for i in range(len(groups)):
+        for j in range(i + 1, len(groups)):
+            a, b = groups[i], groups[j]
+            if (a.timepoint, a.angle) != (b.timepoint, b.angle):
+                continue
+            if not boxes[a.key].overlaps(boxes[b.key]):
+                continue
+            ov = boxes[a.key].intersect(boxes[b.key])
+            if ov.is_empty():
+                continue
+            out.append((a, b, ov))
+    return out
+
+
+def _aggregate(sd: SpimData, crops: dict[ViewId, np.ndarray], group: ViewGroup,
+               params: StitchingParams) -> np.ndarray:
+    """GroupedViewAggregator: combine channels (AVERAGE default) then
+    illuminations (PICK_BRIGHTEST default) of one tile
+    (SparkPairwiseStitching.java:204-208)."""
+    def combine(imgs: list[np.ndarray], how: str) -> np.ndarray:
+        if len(imgs) == 1:
+            return imgs[0]
+        if how == "AVERAGE":
+            return np.mean(imgs, axis=0)
+        if how == "PICK_BRIGHTEST":
+            return imgs[int(np.argmax([np.sum(i, dtype=np.float64) for i in imgs]))]
+        raise ValueError(f"unknown aggregation {how}")
+
+    by_illum: dict[int, list[np.ndarray]] = {}
+    for v in group.views:
+        illum = sd.setups[v.setup].attributes.get("illumination", 0)
+        by_illum.setdefault(illum, []).append(crops[v])
+    per_illum = [combine(imgs, params.channel_combine)
+                 for _, imgs in sorted(by_illum.items())]
+    return combine(per_illum, params.illum_combine)
+
+
+def _downsample_crop(crop: np.ndarray, ds: Sequence[int]) -> np.ndarray:
+    if all(int(f) == 1 for f in ds):
+        return crop.astype(np.float32)
+    pad = [(0, (-crop.shape[d]) % int(ds[d])) for d in range(3)]
+    if any(p[1] for p in pad):
+        crop = np.pad(crop, pad, mode="edge")
+    return np.asarray(downsample_block(crop, tuple(int(f) for f in ds)))
+
+
+@dataclass
+class _PairJob:
+    group_a: ViewGroup
+    group_b: ViewGroup
+    overlap: Interval
+    crop_a: np.ndarray       # downsampled, float32
+    crop_b: np.ndarray
+    # shift post-processing: S = linear @ (p0b - p0a + residual_ds*s)
+    # - (t_a - t_b) with linear/t from the LEVEL model (model o mipmap), or
+    # S = ds*s for the rendered (non-equal-transform) path
+    linear: np.ndarray | None
+    p0_delta: np.ndarray | None
+    t_delta: np.ndarray | None
+    models_a: list[np.ndarray] = field(default_factory=list)
+    models_b: list[np.ndarray] = field(default_factory=list)
+    residual_ds: tuple[int, int, int] = (1, 1, 1)
+
+
+def _equal_linear(models: list[np.ndarray]) -> bool:
+    return all(np.allclose(m[:, :3], models[0][:, :3], atol=1e-9) for m in models)
+
+
+def _pick_common_level(loader, views, ds) -> tuple[int, tuple[int, int, int]] | None:
+    """Coarsest stored mipmap level usable by every view of the pair whose
+    factors exactly divide the requested downsampling (reference
+    openAndDownsample picks stored levels before computing the rest,
+    SparkInterestPointDetection.java:998-1118). None -> read s0."""
+    per_view = []
+    for v in views:
+        factors = loader.downsampling_factors(v.setup)
+        lvl = best_mipmap_level(factors, ds)
+        f = tuple(int(x) for x in factors[lvl])
+        if any(int(ds[d]) % f[d] != 0 for d in range(3)):
+            return None
+        per_view.append((lvl, f))
+    if len({f for _, f in per_view}) != 1:
+        return None
+    return per_view[0]
+
+
+def _extract_pair_job(sd, loader, ga, gb, overlap, params) -> _PairJob | None:
+    models_a = [sd.model(v) for v in ga.views]
+    models_b = [sd.model(v) for v in gb.views]
+    ds = params.downsampling
+
+    if _equal_linear(models_a + models_b):
+        # read at the coarsest stored level that divides the requested
+        # downsampling; the rest is averaged in memory
+        common = _pick_common_level(loader, list(ga.views) + list(gb.views), ds)
+        level, f = common if common is not None else (0, (1, 1, 1))
+        rel = tuple(int(ds[d]) // f[d] for d in range(3))
+        mip = loader.mipmap_transform(ga.views[0].setup, level)
+
+        # raster the overlap into each view's LEVEL pixel space; exact
+        # integer offsets enter the shift formula so rounding costs no
+        # accuracy (model' = model o mipmap: level px -> world)
+        lvl_shape = tuple(
+            int(np.ceil(overlap.shape[d] / f[d])) for d in range(3)
+        )
+
+        def crops_for(group, models):
+            crops = {}
+            p0 = None
+            for v, m in zip(group.views, models):
+                inv = invert_affine(concatenate(m, mip))
+                p0v = np.round(inv[:, :3] @ np.array(overlap.min, np.float64)
+                               + inv[:, 3]).astype(np.int64)
+                if p0 is None:
+                    p0 = p0v
+                crops[v] = loader.read_block(v, level, tuple(p0v), lvl_shape
+                                             ).astype(np.float32)
+            return crops, p0
+
+        crops_a, p0a = crops_for(ga, models_a)
+        crops_b, p0b = crops_for(gb, models_b)
+        agg_a = _aggregate(sd, crops_a, ga, params)
+        agg_b = _aggregate(sd, crops_b, gb, params)
+        total_a = concatenate(models_a[0], mip)
+        total_b = concatenate(models_b[0], mip)
+        return _PairJob(
+            ga, gb, overlap,
+            _downsample_crop(agg_a, rel), _downsample_crop(agg_b, rel),
+            linear=total_a[:, :3].copy(),
+            p0_delta=(p0b - p0a).astype(np.float64),
+            t_delta=(total_a[:, 3] - total_b[:, 3]).copy(),
+            models_a=models_a, models_b=models_b,
+            residual_ds=rel,
+        )
+
+    # non-equal transforms: render each group virtually over the overlap
+    # (computeStitchingNonEqualTransformations, SparkPairwiseStitching.java:259-267)
+    from .affine_fusion import fuse_grid_block
+    from ..utils.grid import GridBlock
+
+    o_ds = Interval(
+        tuple(int(np.floor(overlap.min[d] / ds[d])) for d in range(3)),
+        tuple(int(np.ceil((overlap.max[d] + 1) / ds[d])) - 1 for d in range(3)),
+    )
+    scale = np.diag([1.0 / f for f in ds])
+    pre = np.hstack([scale, np.zeros((3, 1))])
+
+    def render(group):
+        block = GridBlock((0, 0, 0), o_ds.shape, (0, 0, 0))
+        res = fuse_grid_block(
+            sd, loader, list(group.views), block, o_ds,
+            fusion_type="AVG", anisotropy=pre,
+        )
+        if res is None:
+            return None
+        return res[0]
+
+    ra, rb = render(ga), render(gb)
+    if ra is None or rb is None:
+        return None
+    return _PairJob(ga, gb, overlap, ra, rb,
+                    linear=None, p0_delta=None, t_delta=None,
+                    models_a=models_a, models_b=models_b)
+
+
+def _fft_shape(shape: Sequence[int]) -> tuple[int, ...]:
+    """Next power of two per axis (TPU FFTs are fastest/most accurate at
+    powers of two; wrap ambiguity is resolved by the correlation check)."""
+    return tuple(1 << max(0, int(np.ceil(np.log2(max(int(s), 1))))) for s in shape)
+
+
+def stitch_all_pairs(
+    sd: SpimData,
+    loader: ViewLoader,
+    views: list[ViewId],
+    params: StitchingParams | None = None,
+    progress: bool = True,
+) -> list[PairwiseStitchingResult]:
+    """Compute pairwise shifts for every overlapping tile pair.
+
+    Returns unfiltered results; apply ``filter_results`` + store into
+    ``sd.stitching_results`` (the driver-side collect of the reference)."""
+    params = params or StitchingParams()
+    groups = build_groups(sd, views)
+    pairs = plan_pairs(sd, groups)
+    if progress:
+        print(f"stitching: {len(groups)} groups, {len(pairs)} overlapping pairs")
+
+    jobs: list[_PairJob] = []
+    for ga, gb, ov in pairs:
+        with profiling.span("stitching.extract"):
+            job = _extract_pair_job(sd, loader, ga, gb, ov, params)
+        if job is not None:
+            jobs.append(job)
+
+    # bucket by padded FFT shape -> one compile per bucket
+    buckets: dict[tuple, list[_PairJob]] = {}
+    for j in jobs:
+        shp = _fft_shape(np.maximum(j.crop_a.shape, j.crop_b.shape))
+        buckets.setdefault(shp, []).append(j)
+
+    results: list[PairwiseStitchingResult] = []
+    for shp, bjobs in sorted(buckets.items()):
+        for i in range(0, len(bjobs), params.batch_size):
+            chunk = bjobs[i:i + params.batch_size]
+            results.extend(_stitch_one_bucket(sd, chunk, shp, params))
+    return results
+
+
+def _stitch_one_bucket(sd, jobs: list[_PairJob], shp, params) -> list[PairwiseStitchingResult]:
+    a = np.stack([pad_to(j.crop_a, shp) for j in jobs])
+    b = np.stack([pad_to(j.crop_b, shp) for j in jobs])
+    ext_a = np.stack([np.array(j.crop_a.shape, np.int32) for j in jobs])
+    ext_b = np.stack([np.array(j.crop_b.shape, np.int32) for j in jobs])
+    min_ov = np.array(
+        [max(params.min_overlap_px, 0.1 * int(np.prod(j.crop_a.shape)))
+         for j in jobs], np.float32,
+    )
+    with profiling.span("stitching.kernel"):
+        shifts, rs = stitch_crops_batch(
+            a, b, ext_a, ext_b, params.peaks_to_check, min_ov, params.subpixel,
+            0.25,
+        )
+        shifts, rs = np.asarray(shifts), np.asarray(rs)
+
+    ds = np.array(params.downsampling, np.float64)
+    out = []
+    for j, s, r in zip(jobs, shifts, rs):
+        if j.linear is not None:
+            # S = L (p0b - p0a + rel*s) - (t_a - t_b): c_A - c_B = S
+            rel = np.array(j.residual_ds, np.float64)
+            S = j.linear @ (j.p0_delta + rel * s.astype(np.float64)) - j.t_delta
+        else:
+            S = ds * s.astype(np.float64)
+        out.append(PairwiseStitchingResult(
+            views_a=j.group_a.views,
+            views_b=j.group_b.views,
+            transform=translation_affine(S),
+            correlation=float(r),
+            hash=registration_hash(j.models_a, j.models_b),
+            bbox=j.overlap,
+        ))
+    return out
+
+
+def filter_results(
+    results: list[PairwiseStitchingResult], params: StitchingParams,
+    verbose: bool = True,
+) -> list[PairwiseStitchingResult]:
+    """Link filters (FilteredStitchingResults: Correlation, AbsoluteShift,
+    ShiftMagnitude — SparkPairwiseStitching.java:347-382)."""
+    out = []
+    for res in results:
+        shift = res.transform[:, 3]
+        ok = (params.min_r <= res.correlation <= params.max_r
+              and all(abs(shift[d]) <= params.max_shift[d] for d in range(3))
+              and float(np.linalg.norm(shift)) <= params.max_shift_total)
+        if ok:
+            out.append(res)
+        elif verbose:
+            print(f"  dropped pair {res.views_a[0]}<->{res.views_b[0]}: "
+                  f"r={res.correlation:.3f} shift={np.round(shift, 2)}")
+    return out
+
+
+def store_results(sd: SpimData, results: list[PairwiseStitchingResult]) -> None:
+    for res in results:
+        sd.stitching_results[res.pair_key] = res

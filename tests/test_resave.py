@@ -108,6 +108,38 @@ def test_downsample_continues_absolute_factors(synthetic_project):
         == [4, 4, 2]
 
 
+def test_downsample_registers_setup_factors(synthetic_project):
+    """New BDV-layout levels must appear in the setup-level factor list so
+    ViewLoader/best_mipmap_level can discover them."""
+    sd = SpimData.load(synthetic_project.xml_path)
+    container = sd.resolve_loader_path()
+    runner = CliRunner()
+    res = runner.invoke(cli, [
+        "downsample", "-i", container, "-di", "setup1/timepoint0/s0",
+        "-ds", "2,2,1; 2,2,2",
+    ], catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    store = ChunkStore.open(container)
+    factors = store.get_attribute("setup1", "downsamplingFactors")
+    assert [2, 2, 1] in factors and [4, 4, 2] in factors
+    loader = ViewLoader(SpimData.load(synthetic_project.xml_path))
+    assert loader.num_levels(1) == 3
+
+
+def test_downsample_rejects_5d(tmp_path):
+    from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+
+    store = ChunkStore.create(str(tmp_path / "c.zarr"), StorageFormat.ZARR)
+    store.create_dataset("0", (16, 16, 8, 1, 1), (16, 16, 8, 1, 1), "uint16")
+    runner = CliRunner()
+    res = runner.invoke(cli, [
+        "downsample", "-i", str(tmp_path / "c.zarr"), "-di", "0",
+        "-ds", "2,2,1", "-do", "1",
+    ])
+    assert res.exit_code != 0
+    assert "5-D" in res.output
+
+
 def test_downsample_cli(synthetic_project, tmp_path):
     sd = SpimData.load(synthetic_project.xml_path)
     container = sd.resolve_loader_path()

@@ -1,0 +1,168 @@
+"""Phase-correlation stitching: kernel golden tests + ground-truth recovery
+on the synthetic tiled project (reference: SparkPairwiseStitching; the
+synthetic grid with known true/nominal offsets replaces the S3 fixture)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from click.testing import CliRunner
+
+from bigstitcher_spark_tpu.cli.main import cli
+from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+from bigstitcher_spark_tpu.io.spimdata import SpimData
+from bigstitcher_spark_tpu.models.stitching import (
+    StitchingParams,
+    build_groups,
+    plan_pairs,
+    stitch_all_pairs,
+)
+from bigstitcher_spark_tpu.ops.phasecorr import pad_to, stitch_crops
+
+
+def _smooth_noise(shape, seed=0, sigma=2.0):
+    from scipy.ndimage import gaussian_filter
+
+    rng = np.random.default_rng(seed)
+    return gaussian_filter(
+        rng.normal(100, 20, shape).astype(np.float32), sigma
+    )
+
+
+def test_kernel_integer_shift():
+    base = _smooth_noise((80, 80, 40))
+    d = np.array([5, -3, 2])
+    a = base[10:58, 10:58, 8:32]
+    b = base[10 - d[0]:58 - d[0], 10 - d[1]:58 - d[1], 8 - d[2]:32 - d[2]]
+    P = (64, 64, 32)
+    s, r = stitch_crops(pad_to(a, P), pad_to(b, P),
+                        jnp.array(a.shape, jnp.int32),
+                        jnp.array(b.shape, jnp.int32))
+    assert np.allclose(np.asarray(s), d, atol=0.3)
+    assert float(r) > 0.95
+
+
+def test_kernel_subpixel_shift():
+    from scipy.ndimage import shift as ndshift
+
+    base = _smooth_noise((80, 80, 40))
+    d = np.array([2.3, -1.7, 0.5])
+    a = base[10:58, 10:58, 8:32]
+    b = ndshift(base, d, order=3)[10:58, 10:58, 8:32]
+    P = (64, 64, 32)
+    s, r = stitch_crops(pad_to(a, P), pad_to(b, P),
+                        jnp.array(a.shape, jnp.int32),
+                        jnp.array(b.shape, jnp.int32))
+    assert np.allclose(np.asarray(s), d, atol=0.35)
+
+
+def test_kernel_rejects_noise():
+    a = _smooth_noise((48, 48, 24), seed=1)
+    b = _smooth_noise((48, 48, 24), seed=2)
+    P = (64, 64, 32)
+    s, r = stitch_crops(pad_to(a, P), pad_to(b, P),
+                        jnp.array(a.shape, jnp.int32),
+                        jnp.array(b.shape, jnp.int32))
+    assert float(r) < 0.5
+
+
+@pytest.fixture(scope="module")
+def stitch_project(tmp_path_factory):
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    return make_synthetic_project(
+        str(tmp_path_factory.mktemp("stitch") / "proj"),
+        n_tiles=(2, 2, 1), tile_size=(96, 96, 48), overlap=28,
+        jitter=3.0, seed=3, n_beads_per_tile=60,
+    )
+
+
+def test_pair_planning(stitch_project):
+    sd = SpimData.load(stitch_project.xml_path)
+    groups = build_groups(sd, sd.view_ids())
+    assert len(groups) == 4  # 2x2 tiles, 1 channel
+    pairs = plan_pairs(sd, groups)
+    # 4 edge-adjacent + 2 diagonal corner overlaps
+    assert len(pairs) >= 4
+
+
+def test_stitching_recovers_ground_truth(stitch_project):
+    proj = stitch_project
+    sd = SpimData.load(proj.xml_path)
+    loader = ViewLoader(sd)
+    results = stitch_all_pairs(sd, loader, sd.view_ids(),
+                               StitchingParams(downsampling=(1, 1, 1)))
+    assert len(results) >= 4
+    checked = 0
+    for res in results:
+        sa = res.views_a[0].setup
+        sb = res.views_b[0].setup
+        e_a = proj.true_offsets[sa] - proj.nominal_offsets[sa]
+        e_b = proj.true_offsets[sb] - proj.nominal_offsets[sb]
+        expected = e_a - e_b  # c_A - c_B convention
+        shift = res.transform[:, 3]
+        if res.correlation > 0.5:  # diagonal corner overlaps may be tiny
+            np.testing.assert_allclose(shift, expected, atol=0.75)
+            checked += 1
+    assert checked >= 4
+
+
+def test_stitching_downsampled_still_recovers(stitch_project):
+    proj = stitch_project
+    sd = SpimData.load(proj.xml_path)
+    loader = ViewLoader(sd)
+    results = stitch_all_pairs(sd, loader, sd.view_ids(),
+                               StitchingParams(downsampling=(2, 2, 1)))
+    good = 0
+    for res in results:
+        sa, sb = res.views_a[0].setup, res.views_b[0].setup
+        expected = ((proj.true_offsets[sa] - proj.nominal_offsets[sa])
+                    - (proj.true_offsets[sb] - proj.nominal_offsets[sb]))
+        if res.correlation > 0.5:
+            np.testing.assert_allclose(res.transform[:, 3], expected, atol=1.5)
+            good += 1
+    assert good >= 4
+
+
+def test_stitching_reads_stored_mipmap_level(tmp_path):
+    """With a stored 2,2,1 level and ds=2,2,1 the crops come from s1
+    (residual 1,1,1) and ground truth is still recovered."""
+    from unittest import mock
+
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    proj = make_synthetic_project(
+        str(tmp_path / "proj"), n_tiles=(2, 1, 1), tile_size=(96, 96, 48),
+        overlap=28, jitter=3.0, seed=5,
+        downsampling_factors=((1, 1, 1), (2, 2, 1)),
+    )
+    sd = SpimData.load(proj.xml_path)
+    loader = ViewLoader(sd)
+    levels_read = []
+    orig = ViewLoader.read_block
+
+    def spy(self, view, level, offset, shape, pad_value=0.0):
+        levels_read.append(level)
+        return orig(self, view, level, offset, shape, pad_value)
+
+    with mock.patch.object(ViewLoader, "read_block", spy):
+        results = stitch_all_pairs(sd, loader, sd.view_ids(),
+                                   StitchingParams(downsampling=(2, 2, 1)))
+    assert levels_read and all(lv == 1 for lv in levels_read)
+    (res,) = results
+    sa, sb = res.views_a[0].setup, res.views_b[0].setup
+    expected = ((proj.true_offsets[sa] - proj.nominal_offsets[sa])
+                - (proj.true_offsets[sb] - proj.nominal_offsets[sb]))
+    np.testing.assert_allclose(res.transform[:, 3], expected, atol=1.5)
+
+
+def test_stitching_cli_writes_results(stitch_project):
+    runner = CliRunner()
+    res = runner.invoke(cli, [
+        "stitching", "-x", stitch_project.xml_path, "-ds", "1,1,1",
+    ], catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    sd = SpimData.load(stitch_project.xml_path)
+    assert len(sd.stitching_results) >= 4
+    for res_ in sd.stitching_results.values():
+        assert res_.hash != 0.0
+        assert res_.correlation > 0.3
