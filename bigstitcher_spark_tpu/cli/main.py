@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import click
 
-from . import fusion_tools, resave_tools, stitching_tools
+from . import fusion_tools, resave_tools, solver_tools, stitching_tools
 
 
 @click.group()
@@ -22,6 +22,7 @@ cli.add_command(fusion_tools.affine_fusion_cmd, "affine-fusion")
 cli.add_command(resave_tools.resave_cmd, "resave")
 cli.add_command(resave_tools.downsample_cmd, "downsample")
 cli.add_command(stitching_tools.stitching_cmd, "stitching")
+cli.add_command(solver_tools.solver_cmd, "solver")
 
 
 def register(module_names: list[str]) -> None:

@@ -150,8 +150,10 @@ def downsample_cmd(path_in, dataset_in, datasets_out, downsampling,
     abs_factor = [int(v) for v in
                   (store.get_attribute(src_path, "downsamplingFactors")
                    or [1, 1, 1])]
+    abs_factors: list[list[int]] = []  # one per output level, for registration
     for step, out_path in zip(steps, outs):
         abs_factor = [a * f for a, f in zip(abs_factor, step)]
+        abs_factors.append(list(abs_factor))
         dims = [max(1, s // f) for s, f in zip(prev.shape, step)]
         dst = store.create_dataset(out_path, dims, prev.block_size,
                                    prev.dtype.name, delete_existing=True)
@@ -169,24 +171,36 @@ def downsample_cmd(path_in, dataset_in, datasets_out, downsampling,
         prev = dst
 
     # BDV layout (setup{S}/timepoint{T}/s{N}): extend the setup-level factor
-    # list so ViewLoader/best_mipmap_level can discover the new levels
+    # list so ViewLoader/best_mipmap_level can discover the new levels.
+    # ViewLoader resolves level i -> dataset s{i}, so a factor may only be
+    # registered when its output leaf IS s{len(list)} at registration time.
     parts = src_path.split("/")
     if (len(parts) == 3 and parts[0].startswith("setup")
             and all(p.strip("/").split("/")[0] == parts[0]
                     and len(p.strip("/").split("/")) == 3 for p in outs)):
         setup_group = parts[0]
         existing = store.get_attribute(setup_group, "downsamplingFactors") or []
-        known = {tuple(int(v) for v in f) for f in existing}
-        added = []
-        af = [int(v) for v in
-              (store.get_attribute(src_path, "downsamplingFactors")
-               or [1, 1, 1])]
-        for step in steps:
-            af = [a * f for a, f in zip(af, step)]
-            if tuple(af) not in known:
-                existing.append(list(af))
-                added.append(list(af))
+        existing = [list(map(int, f)) for f in existing]
+        if not existing and parts[2] == "s0":
+            # fresh single-scale dataset: seed the list with the input level
+            existing = [[int(v) for v in
+                         (store.get_attribute(src_path, "downsamplingFactors")
+                          or [1, 1, 1])]]
+        added, skipped = [], []
+        for out_path, af in zip(outs, abs_factors):
+            leaf = out_path.split("/")[-1]
+            if af in existing and leaf == f"s{existing.index(af)}":
+                continue  # already registered at the matching index
+            if leaf == f"s{len(existing)}":
+                existing.append(af)
+                added.append(af)
+            else:
+                skipped.append(out_path)
         if added:
             store.set_attribute(setup_group, "downsamplingFactors", existing)
             store.set_attribute(f"{setup_group}/{parts[1]}", "multiScale", True)
             click.echo(f"  registered factors {added} on {setup_group}")
+        for p in skipped:
+            click.echo(f"  WARNING: {p} not registered on {setup_group} — its "
+                       f"s<N> index does not continue the existing level list "
+                       f"(levels must be consecutive s0..s{len(existing) - 1})")

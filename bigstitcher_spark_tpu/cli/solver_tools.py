@@ -1,0 +1,93 @@
+"""``solver`` CLI: global optimization of view registrations
+(reference: Solver.java:104-158 options + AbstractRegistration.java:62-77)."""
+
+from __future__ import annotations
+
+import click
+import numpy as np
+
+from ..io.spimdata import ViewId
+from ..models import solver as S
+from ..ops import models as M
+from .common import (
+    infrastructure_options,
+    load_project,
+    select_views_from_kwargs,
+    view_selection_options,
+    xml_option,
+)
+
+
+@click.command()
+@xml_option
+@view_selection_options
+@infrastructure_options
+@click.option("-s", "--sourcePoints", "source", required=True,
+              type=click.Choice(["IP", "STITCHING"], case_sensitive=False),
+              help="source of the solve: IP (interest points) or STITCHING")
+@click.option("-l", "--label", "labels", multiple=True,
+              help="interest-point label(s) used for registration")
+@click.option("-lw", "--labelweights", "label_weights", multiple=True, type=float,
+              help="weight per label (default 1.0)")
+@click.option("--method", default="ONE_ROUND_SIMPLE",
+              type=click.Choice(["ONE_ROUND_SIMPLE", "ONE_ROUND_ITERATIVE",
+                                 "TWO_ROUND_SIMPLE", "TWO_ROUND_ITERATIVE"]),
+              help="two-round handles unconnected tiles, iterative drops wrong links")
+@click.option("-tm", "--transformationModel", "model", default="TRANSLATION",
+              type=click.Choice(["TRANSLATION", "RIGID", "AFFINE"]),
+              help="transformation model (default TRANSLATION for stitching)")
+@click.option("-rm", "--regularizationModel", "regularization", default="NONE",
+              type=click.Choice(["NONE", "IDENTITY", "TRANSLATION", "RIGID", "AFFINE"]))
+@click.option("--lambda", "lam", default=0.1, type=float,
+              help="regularizer interpolation weight (default 0.1)")
+@click.option("--maxError", "max_error", default=5.0, type=float)
+@click.option("--maxIterations", "max_iterations", default=10000, type=int)
+@click.option("--maxPlateauwidth", "max_plateau_width", default=200, type=int)
+@click.option("--relativeThreshold", "relative_threshold", default=3.5, type=float)
+@click.option("--absoluteThreshold", "absolute_threshold", default=7.0, type=float)
+@click.option("--disableFixedViews", "disable_fixed_views", is_flag=True)
+@click.option("-fv", "--fixedViews", "fixed_views", multiple=True,
+              help="fixed view ids 'timepoint,setup' (default: first per subset)")
+@click.option("--groupIllums/--no-groupIllums", "group_illums", default=None)
+@click.option("--groupChannels/--no-groupChannels", "group_channels", default=None)
+@click.option("--groupTiles", "group_tiles", is_flag=True)
+@click.option("--splitTimepoints", "split_timepoints", is_flag=True)
+def solver_cmd(xml, dry_run, source, labels, label_weights, method, model,
+               regularization, lam, max_error, max_iterations,
+               max_plateau_width, relative_threshold, absolute_threshold,
+               disable_fixed_views, fixed_views, group_illums, group_channels,
+               group_tiles, split_timepoints, **kwargs):
+    """Globally optimize per-view transforms from stitching shifts or
+    corresponding interest points; writes the result into the XML."""
+    sd = load_project(xml)
+    views = select_views_from_kwargs(sd, kwargs)
+    params = S.SolverParams(
+        source=source.upper(),
+        method=method,
+        model=model,
+        regularization=regularization,
+        lam=lam,
+        max_error=max_error,
+        max_iterations=max_iterations,
+        max_plateau_width=max_plateau_width,
+        relative_threshold=relative_threshold,
+        absolute_threshold=absolute_threshold,
+        disable_fixed_views=disable_fixed_views,
+        fixed_views=[ViewId(*map(int, fv.split(","))) for fv in fixed_views],
+        labels=list(labels),
+        label_weights=list(label_weights),
+        group_illums=group_illums,
+        group_channels=group_channels,
+        group_tiles=group_tiles,
+        split_timepoints=split_timepoints,
+    )
+    result = S.solve(sd, views, params)
+    for key, corr in sorted(result.corrections.items()):
+        print(f"  {key[0]}{'+' + str(len(key) - 1) if len(key) > 1 else ''}: "
+              f"t={np.round(corr[:, 3], 3)}")
+    if dry_run:
+        print("dryRun: not saving XML")
+        return
+    S.store_corrections(sd, result, params)
+    sd.save()
+    print(f"saved {xml}")

@@ -82,6 +82,6 @@ def stitching_cmd(xml, downsampling, peaks, no_subpixel, min_r, max_r,
     if dry_run:
         click.echo("(dry run, not saving)")
         return
-    store_results(sd, kept)
+    store_results(sd, kept, computed=results)
     sd.save(xml)
     click.echo(f"saved StitchingResults -> {xml}")

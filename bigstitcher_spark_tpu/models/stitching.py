@@ -168,22 +168,27 @@ def _equal_linear(models: list[np.ndarray]) -> bool:
     return all(np.allclose(m[:, :3], models[0][:, :3], atol=1e-9) for m in models)
 
 
-def _pick_common_level(loader, views, ds) -> tuple[int, tuple[int, int, int]] | None:
+def _pick_common_level(loader, views, ds) -> tuple[dict, tuple[int, int, int]] | None:
     """Coarsest stored mipmap level usable by every view of the pair whose
     factors exactly divide the requested downsampling (reference
     openAndDownsample picks stored levels before computing the rest,
-    SparkInterestPointDetection.java:998-1118). None -> read s0."""
-    per_view = []
+    SparkInterestPointDetection.java:998-1118). Views may store the same
+    factors at different level indexes, so the per-view LEVEL is returned
+    alongside the common factors. None -> read s0."""
+    levels: dict = {}
+    common_f = None
     for v in views:
         factors = loader.downsampling_factors(v.setup)
         lvl = best_mipmap_level(factors, ds)
         f = tuple(int(x) for x in factors[lvl])
         if any(int(ds[d]) % f[d] != 0 for d in range(3)):
             return None
-        per_view.append((lvl, f))
-    if len({f for _, f in per_view}) != 1:
-        return None
-    return per_view[0]
+        if common_f is None:
+            common_f = f
+        elif f != common_f:
+            return None
+        levels[v] = lvl
+    return levels, common_f
 
 
 def _extract_pair_job(sd, loader, ga, gb, overlap, params) -> _PairJob | None:
@@ -194,10 +199,14 @@ def _extract_pair_job(sd, loader, ga, gb, overlap, params) -> _PairJob | None:
     if _equal_linear(models_a + models_b):
         # read at the coarsest stored level that divides the requested
         # downsampling; the rest is averaged in memory
-        common = _pick_common_level(loader, list(ga.views) + list(gb.views), ds)
-        level, f = common if common is not None else (0, (1, 1, 1))
+        all_views = list(ga.views) + list(gb.views)
+        common = _pick_common_level(loader, all_views, ds)
+        if common is None:
+            levels, f = {v: 0 for v in all_views}, (1, 1, 1)
+        else:
+            levels, f = common
         rel = tuple(int(ds[d]) // f[d] for d in range(3))
-        mip = loader.mipmap_transform(ga.views[0].setup, level)
+        mip = loader.mipmap_transform(ga.views[0].setup, levels[ga.views[0]])
 
         # raster the overlap into each view's LEVEL pixel space; exact
         # integer offsets enter the shift formula so rounding costs no
@@ -215,7 +224,7 @@ def _extract_pair_job(sd, loader, ga, gb, overlap, params) -> _PairJob | None:
                                + inv[:, 3]).astype(np.int64)
                 if p0 is None:
                     p0 = p0v
-                crops[v] = loader.read_block(v, level, tuple(p0v), lvl_shape
+                crops[v] = loader.read_block(v, levels[v], tuple(p0v), lvl_shape
                                              ).astype(np.float32)
             return crops, p0
 
@@ -365,6 +374,15 @@ def filter_results(
     return out
 
 
-def store_results(sd: SpimData, results: list[PairwiseStitchingResult]) -> None:
+def store_results(
+    sd: SpimData,
+    results: list[PairwiseStitchingResult],
+    computed: list[PairwiseStitchingResult] | None = None,
+) -> None:
+    """Store kept results; entries for every RECOMPUTED pair (``computed``,
+    default = ``results``) are cleared first so links the user just filtered
+    out don't survive from a previous run."""
+    for res in computed if computed is not None else results:
+        sd.stitching_results.pop(res.pair_key, None)
     for res in results:
         sd.stitching_results[res.pair_key] = res

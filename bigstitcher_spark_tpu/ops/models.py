@@ -1,0 +1,117 @@
+"""3-D transformation-model fitting: weighted least squares for translation /
+rigid / affine point-correspondence fits, plus regularization by model
+interpolation.
+
+Role of ``mpicbg.models.{TranslationModel3D, RigidModel3D, AffineModel3D,
+InterpolatedAffineModel3D}`` used by the reference at
+AbstractRegistration.java:110-140 and Solver.java:294-369. All fits map point
+sets p -> q (``q ~= M @ [p;1]``), weighted; models are 3x4 row-major affines
+(utils.geometry convention).
+
+Everything here is written against the numpy API surface shared by
+``numpy``/``jax.numpy`` so the same math serves the host-side solver (numpy)
+and the vmapped RANSAC hypothesis kernels (jax) — pass ``xp=jax.numpy`` to
+fit under jit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TRANSLATION = "TRANSLATION"
+RIGID = "RIGID"
+AFFINE = "AFFINE"
+IDENTITY = "IDENTITY"
+NONE = "NONE"
+
+MIN_POINTS = {TRANSLATION: 1, RIGID: 3, AFFINE: 4, IDENTITY: 0}
+
+
+def _wmean(x, w, xp):
+    return (x * w[..., None]).sum(-2) / w.sum(-1)[..., None]
+
+
+def fit_translation(p, q, w=None, xp=np):
+    """t = weighted mean(q - p); batched over leading dims."""
+    p = xp.asarray(p, dtype=xp.float64 if xp is np else p.dtype)
+    q = xp.asarray(q, dtype=p.dtype)
+    if w is None:
+        w = xp.ones(p.shape[:-1], dtype=p.dtype)
+    t = _wmean(q - p, w, xp)
+    eye = xp.broadcast_to(xp.eye(3, dtype=p.dtype), p.shape[:-2] + (3, 3))
+    return xp.concatenate([eye, t[..., :, None]], axis=-1)
+
+
+def fit_rigid(p, q, w=None, xp=np):
+    """Weighted Kabsch: R = V diag(1,1,det) U^T from the cross-covariance SVD;
+    batched over leading dims."""
+    p = xp.asarray(p, dtype=xp.float64 if xp is np else p.dtype)
+    q = xp.asarray(q, dtype=p.dtype)
+    if w is None:
+        w = xp.ones(p.shape[:-1], dtype=p.dtype)
+    pc = _wmean(p, w, xp)
+    qc = _wmean(q, w, xp)
+    pp = p - pc[..., None, :]
+    qq = q - qc[..., None, :]
+    # H = sum_i w_i p_i q_i^T
+    h = xp.einsum("...n,...ni,...nj->...ij", w, pp, qq)
+    u, _, vt = xp.linalg.svd(h)
+    d = xp.linalg.det(xp.swapaxes(vt, -1, -2) @ xp.swapaxes(u, -1, -2))
+    sign = xp.stack(
+        [xp.ones_like(d), xp.ones_like(d), d], axis=-1
+    )
+    r = xp.swapaxes(vt, -1, -2) @ (sign[..., :, None] * xp.swapaxes(u, -1, -2))
+    t = qc - xp.einsum("...ij,...j->...i", r, pc)
+    return xp.concatenate([r, t[..., :, None]], axis=-1)
+
+
+def fit_affine(p, q, w=None, xp=np, eps=1e-12):
+    """Weighted linear least squares for the full 3x4 affine (normal
+    equations over homogeneous p; batched over leading dims)."""
+    p = xp.asarray(p, dtype=xp.float64 if xp is np else p.dtype)
+    q = xp.asarray(q, dtype=p.dtype)
+    if w is None:
+        w = xp.ones(p.shape[:-1], dtype=p.dtype)
+    ones = xp.ones(p.shape[:-1] + (1,), dtype=p.dtype)
+    ph = xp.concatenate([p, ones], axis=-1)  # (..., N, 4)
+    a = xp.einsum("...n,...ni,...nj->...ij", w, ph, ph)
+    b = xp.einsum("...n,...ni,...nk->...ik", w, ph, q)  # (..., 4, 3)
+    a = a + eps * xp.eye(4, dtype=p.dtype)
+    sol = xp.linalg.solve(a, b)  # (..., 4, 3)
+    return xp.swapaxes(sol, -1, -2)
+
+
+def fit_model(kind: str, p, q, w=None, xp=np):
+    if kind == TRANSLATION:
+        return fit_translation(p, q, w, xp)
+    if kind == RIGID:
+        return fit_rigid(p, q, w, xp)
+    if kind == AFFINE:
+        return fit_affine(p, q, w, xp)
+    if kind == IDENTITY:
+        p = xp.asarray(p)
+        eye = xp.concatenate([xp.eye(3), xp.zeros((3, 1))], axis=-1)
+        return xp.broadcast_to(eye, p.shape[:-2] + (3, 4))
+    raise ValueError(f"unknown model {kind!r}")
+
+
+def fit_interpolated(kind: str, reg_kind: str, lam: float, p, q, w=None, xp=np):
+    """InterpolatedAffineModel3D semantics: fit both models to the same
+    matches, then linearly interpolate the affine entries
+    (m = (1-λ)·tm + λ·rm; AbstractRegistration.java:134-140)."""
+    m = fit_model(kind, p, q, w, xp)
+    if reg_kind == NONE or lam == 0.0:
+        return m
+    r = fit_model(reg_kind, p, q, w, xp)
+    return (1.0 - lam) * m + lam * r
+
+
+def model_error(m, p, q, w=None, xp=np):
+    """Weighted RMS distance ||M(p) - q|| (mpicbg Tile cost)."""
+    p = xp.asarray(p)
+    q = xp.asarray(q)
+    pred = xp.einsum("...ij,...nj->...ni", m[..., :, :3], p) + m[..., None, :, 3]
+    d = xp.sqrt(((pred - q) ** 2).sum(-1))
+    if w is None:
+        return d.mean(-1)
+    return (d * w).sum(-1) / w.sum(-1)
