@@ -1,0 +1,82 @@
+"""CLI: detect-interestpoints, match-interestpoints, clear-interestpoints
+(reference tools SparkInterestPointDetection / SparkGeometricDescriptorMatching
+/ ClearInterestPoints)."""
+
+from __future__ import annotations
+
+import click
+
+from .common import (
+    infrastructure_options,
+    load_project,
+    select_views_from_kwargs,
+    view_selection_options,
+    xml_option,
+)
+
+
+@click.command()
+@xml_option
+@view_selection_options
+@infrastructure_options
+@click.option("-l", "--label", default="beads", help="interest point label")
+@click.option("-s", "--sigma", default=1.8, type=float,
+              help="DoG sigma (at detection resolution)")
+@click.option("-t", "--threshold", default=0.008, type=float,
+              help="DoG response threshold")
+@click.option("-dsxy", "--downsampleXY", "downsample_xy", default=2, type=int)
+@click.option("-dsz", "--downsampleZ", "downsample_z", default=1, type=int)
+@click.option("--minIntensity", "min_intensity", default=None, type=float)
+@click.option("--maxIntensity", "max_intensity", default=None, type=float)
+@click.option("--type", "extrema", default="MAX",
+              type=click.Choice(["MAX", "MIN", "BOTH"]),
+              help="detect maxima, minima or both")
+@click.option("--overlappingOnly", "overlapping_only", is_flag=True,
+              help="only detect in regions overlapping other selected views")
+@click.option("--maxSpots", "max_spots", default=0, type=int,
+              help="keep only the brightest N spots per view (0 = all)")
+@click.option("--maxSpotsPerOverlap", "max_spots_per_overlap", is_flag=True,
+              help="distribute --maxSpots over overlap regions by volume")
+@click.option("--storeIntensities", "store_intensities", is_flag=True,
+              help="sample + store per-point image intensities")
+@click.option("--medianFilter", "median_radius", default=0, type=int,
+              help="background-divide by per-slice median of this radius (0=off)")
+@click.option("--blockSize", "block_size", default="512,512,128",
+              help="detection block size at detection resolution")
+def detect_interestpoints_cmd(xml, dry_run, **kw):
+    """Distributed DoG interest-point detection (SparkInterestPointDetection)."""
+    from ..io.dataset_io import ViewLoader
+    from ..io.interestpoints import InterestPointStore
+    from ..models.detection import (
+        DetectionParams,
+        detect_interest_points,
+        save_detections,
+    )
+    from .common import parse_csv_ints
+
+    sd = load_project(xml)
+    views = select_views_from_kwargs(sd, kw)
+    params = DetectionParams(
+        label=kw["label"], sigma=kw["sigma"], threshold=kw["threshold"],
+        downsample_xy=kw["downsample_xy"], downsample_z=kw["downsample_z"],
+        min_intensity=kw["min_intensity"], max_intensity=kw["max_intensity"],
+        find_max=kw["extrema"] in ("MAX", "BOTH"),
+        find_min=kw["extrema"] in ("MIN", "BOTH"),
+        overlapping_only=kw["overlapping_only"],
+        max_spots=kw["max_spots"],
+        max_spots_per_overlap=kw["max_spots_per_overlap"],
+        store_intensities=kw["store_intensities"],
+        median_radius=kw["median_radius"],
+        block_size=tuple(parse_csv_ints(kw["block_size"], 3)),
+    )
+    loader = ViewLoader(sd)
+    detections = detect_interest_points(sd, loader, views, params)
+    total = sum(len(d.points) for d in detections)
+    print(f"detected {total} interest points over {len(detections)} views")
+    if dry_run:
+        print("dryRun: not saving")
+        return
+    store = InterestPointStore.for_project(sd)
+    save_detections(sd, store, detections, params)
+    sd.save(xml)
+    print(f"saved interest points '{params.label}' + XML")

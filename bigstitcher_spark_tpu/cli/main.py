@@ -9,7 +9,13 @@ from __future__ import annotations
 
 import click
 
-from . import fusion_tools, resave_tools, solver_tools, stitching_tools
+from . import (
+    detection_tools,
+    fusion_tools,
+    resave_tools,
+    solver_tools,
+    stitching_tools,
+)
 
 
 @click.group()
@@ -23,6 +29,7 @@ cli.add_command(resave_tools.resave_cmd, "resave")
 cli.add_command(resave_tools.downsample_cmd, "downsample")
 cli.add_command(stitching_tools.stitching_cmd, "stitching")
 cli.add_command(solver_tools.solver_cmd, "solver")
+cli.add_command(detection_tools.detect_interestpoints_cmd, "detect-interestpoints")
 
 
 def register(module_names: list[str]) -> None:

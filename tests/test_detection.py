@@ -1,0 +1,248 @@
+"""DoG interest-point detection: kernel-level golden tests on synthetic beads
+(the unit-test strategy SURVEY.md §4 calls for — the reference itself only
+smoke-tests) plus project-level round trips through the CLI + store."""
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+
+def _bead_volume(shape, positions, sigma=1.8, amp=1000.0, bg=100.0):
+    vol = np.full(shape, bg, np.float32)
+    r = int(np.ceil(4 * sigma))
+    ax = np.arange(-r, r + 1, dtype=np.float32)
+    for p in positions:
+        ip = np.round(p).astype(int)
+        fr = np.asarray(p) - ip
+        gx = np.exp(-((ax - fr[0]) ** 2) / (2 * sigma**2))
+        gy = np.exp(-((ax - fr[1]) ** 2) / (2 * sigma**2))
+        gz = np.exp(-((ax - fr[2]) ** 2) / (2 * sigma**2))
+        blob = amp * gx[:, None, None] * gy[None, :, None] * gz[None, None, :]
+        vol[ip[0] - r:ip[0] + r + 1, ip[1] - r:ip[1] + r + 1,
+            ip[2] - r:ip[2] + r + 1] += blob
+    return vol
+
+
+class TestDogKernel:
+    def test_single_bead_subpixel(self):
+        from bigstitcher_spark_tpu.ops.dog import dog_block, localize_quadratic
+
+        true = np.array([24.3, 25.7, 22.5])
+        vol = _bead_volume((48, 48, 48), [true])
+        dog, mask = dog_block(vol, np.float32(0.0), np.float32(1200.0),
+                              np.float32(0.005), 1.8)
+        dog, mask = np.asarray(dog), np.asarray(mask)
+        coords = np.argwhere(mask)
+        assert len(coords) == 1
+        sub, vals = localize_quadratic(dog, coords)
+        assert np.linalg.norm(sub[0] - true) < 0.35
+        assert vals[0] > 0.005
+
+    def test_threshold_rejects_noise(self):
+        from bigstitcher_spark_tpu.ops.dog import dog_block
+
+        rng = np.random.default_rng(3)
+        vol = rng.normal(100.0, 2.0, (40, 40, 40)).astype(np.float32)
+        _, mask = dog_block(vol, np.float32(0.0), np.float32(1000.0),
+                            np.float32(0.008), 1.8)
+        assert int(np.asarray(mask).sum()) == 0
+
+    def test_minima_detection(self):
+        from bigstitcher_spark_tpu.ops.dog import dog_block
+
+        true = np.array([20.0, 20.0, 20.0])
+        vol = 2000.0 - _bead_volume((40, 40, 40), [true], bg=0.0)
+        _, mask = dog_block(vol, np.float32(0.0), np.float32(2000.0),
+                            np.float32(0.005), 1.8,
+                            find_max=False, find_min=True)
+        coords = np.argwhere(np.asarray(mask))
+        assert len(coords) == 1
+        assert np.linalg.norm(coords[0] - true) <= 1.0
+
+    def test_blocked_equals_whole(self):
+        """Halo correctness: detections from a blocked run must equal the
+        single-volume run (the reference's ±1px-halo seamlessness invariant,
+        SparkInterestPointDetection.java:412-422)."""
+        from bigstitcher_spark_tpu.ops.dog import dog_block, dog_halo
+
+        rng = np.random.default_rng(7)
+        pos = rng.uniform(10, 86, (25, 3))
+        vol = _bead_volume((96, 96, 96), pos)
+        _, mask_full = dog_block(vol, np.float32(0.0), np.float32(1200.0),
+                                 np.float32(0.005), 1.8)
+        full_set = {tuple(c) for c in np.argwhere(np.asarray(mask_full))}
+
+        halo = dog_halo(1.8)
+        got = set()
+        for off in [(0, 0, 0), (48, 0, 0), (0, 48, 0), (48, 48, 0),
+                    (0, 0, 48), (48, 0, 48), (0, 48, 48), (48, 48, 48)]:
+            lo = np.maximum(np.array(off) - halo, 0)
+            hi = np.minimum(np.array(off) + 48 + halo, 96)
+            pad_lo = halo - (np.array(off) - lo)
+            block = vol[lo[0]:hi[0], lo[1]:hi[1], lo[2]:hi[2]]
+            block = np.pad(block, [(int(halo - (off[d] - lo[d])),
+                                    int(halo - (hi[d] - off[d] - 48)))
+                                   for d in range(3)], mode="reflect")
+            _, m = dog_block(block, np.float32(0.0), np.float32(1200.0),
+                             np.float32(0.005), 1.8,
+                             origin=np.array(off, np.int32) - halo)
+            m = np.asarray(m)
+            core = m[halo:halo + 48, halo:halo + 48, halo:halo + 48]
+            for c in np.argwhere(core):
+                got.add(tuple(c + np.array(off)))
+        # interior detections must agree exactly; allow border-artifact
+        # differences within the blur radius of the volume edge
+        interior = {c for c in full_set if all(halo <= v < 96 - halo for v in c)}
+        assert interior <= got
+        extra = got - full_set
+        assert all(any(v < halo or v >= 96 - halo for v in c) for c in extra)
+
+
+class TestDetectionPipeline:
+    @pytest.fixture(scope="class")
+    def project(self, tmp_path_factory):
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+        return make_synthetic_project(
+            str(tmp_path_factory.mktemp("det") / "proj"),
+            n_tiles=(2, 1, 1), tile_size=(96, 96, 48), overlap=24,
+            jitter=2.0, seed=5, n_beads_per_tile=30,
+        )
+
+    def test_detect_recovers_beads(self, project):
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models.detection import (
+            DetectionParams, detect_interest_points,
+        )
+
+        sd = SpimData.load(project.xml_path)
+        loader = ViewLoader(sd)
+        views = sorted(sd.registrations)
+        params = DetectionParams(downsample_xy=1, downsample_z=1,
+                                 block_size=(64, 64, 64))
+        dets = detect_interest_points(sd, loader, views, params, progress=False)
+        assert len(dets) == 2
+        for det in dets:
+            off = project.true_offsets[det.view.setup]
+            local_beads = project.bead_positions - off
+            inside = np.all(
+                (local_beads >= 6) & (local_beads <= np.array([96, 96, 48]) - 7),
+                axis=1,
+            )
+            local_beads = local_beads[inside]
+            assert len(det.points) >= 0.7 * len(local_beads)
+            # every expected bead has a detection within 1 px
+            d = np.linalg.norm(
+                local_beads[:, None, :] - det.points[None, :, :], axis=2
+            )
+            matched = (d.min(axis=1) < 1.0).mean()
+            assert matched > 0.8
+
+    def test_downsampled_coords_corrected(self, project):
+        """Detection at ds=2,2,1 must return full-res coordinates matching
+        the ds=1 run (correctForDownsampling)."""
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models.detection import (
+            DetectionParams, detect_interest_points,
+        )
+
+        sd = SpimData.load(project.xml_path)
+        loader = ViewLoader(sd)
+        views = sorted(sd.registrations)[:1]
+        full = detect_interest_points(
+            sd, loader, views,
+            DetectionParams(downsample_xy=1, downsample_z=1,
+                            block_size=(64, 64, 64)),
+            progress=False,
+        )[0]
+        ds = detect_interest_points(
+            sd, loader, views,
+            DetectionParams(downsample_xy=2, downsample_z=1, sigma=1.3,
+                            block_size=(64, 64, 64)),
+            progress=False,
+        )[0]
+        assert len(ds.points) > 0
+        d = np.linalg.norm(
+            full.points[:, None, :] - ds.points[None, :, :], axis=2
+        )
+        # most downsampled detections coincide with a full-res one (<1.5px)
+        assert (d.min(axis=0) < 1.5).mean() > 0.7
+
+    def test_overlapping_only_and_store(self, project, tmp_path):
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.interestpoints import InterestPointStore
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models.detection import (
+            DetectionParams, detect_interest_points, save_detections,
+        )
+
+        sd = SpimData.load(project.xml_path)
+        loader = ViewLoader(sd)
+        views = sorted(sd.registrations)
+        params = DetectionParams(
+            downsample_xy=1, downsample_z=1, overlapping_only=True,
+            store_intensities=True, block_size=(64, 64, 64),
+        )
+        dets = detect_interest_points(sd, loader, views, params, progress=False)
+        # tiles are 96 wide with ~24 overlap: view 0's overlap is x>~70
+        for det, xlim in zip(dets, (60.0, 36.0)):
+            assert len(det.points) > 0
+            if det.view.setup == 0:
+                assert np.all(det.points[:, 0] >= xlim)
+            else:
+                assert np.all(det.points[:, 0] <= xlim)
+            assert det.intensities is not None
+            assert np.all(det.intensities > 100.0)  # beads are above background
+
+        store = InterestPointStore(str(tmp_path / "ip.n5"))
+        save_detections(sd, store, dets, params)
+        for det in dets:
+            ids, locs = store.load_points(det.view, params.label)
+            assert len(ids) == len(det.points)
+            np.testing.assert_allclose(locs, det.points, atol=1e-9)
+            assert det.view in sd.interest_points
+            assert "beads" in sd.interest_points[det.view]
+
+    def test_max_spots(self, project):
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models.detection import (
+            DetectionParams, detect_interest_points,
+        )
+
+        sd = SpimData.load(project.xml_path)
+        loader = ViewLoader(sd)
+        views = sorted(sd.registrations)[:1]
+        dets = detect_interest_points(
+            sd, loader, views,
+            DetectionParams(downsample_xy=1, downsample_z=1, max_spots=5,
+                            block_size=(64, 64, 64)),
+            progress=False,
+        )
+        assert len(dets[0].points) == 5
+
+
+def test_cli_detect(tmp_path):
+    from bigstitcher_spark_tpu.cli.main import cli
+    from bigstitcher_spark_tpu.io.interestpoints import InterestPointStore
+    from bigstitcher_spark_tpu.io.spimdata import SpimData, ViewId
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    proj = make_synthetic_project(
+        str(tmp_path / "proj"), n_tiles=(2, 1, 1), tile_size=(64, 64, 32),
+        overlap=16, jitter=0.0, seed=2, n_beads_per_tile=15,
+    )
+    runner = CliRunner()
+    res = runner.invoke(cli, [
+        "detect-interestpoints", "-x", proj.xml_path,
+        "-dsxy", "1", "-dsz", "1", "--blockSize", "64,64,32",
+        "--label", "beads",
+    ])
+    assert res.exit_code == 0, res.output
+    sd = SpimData.load(proj.xml_path)
+    assert ViewId(0, 0) in sd.interest_points
+    store = InterestPointStore.for_project(sd)
+    ids, locs = store.load_points(ViewId(0, 0), "beads")
+    assert len(ids) > 5
