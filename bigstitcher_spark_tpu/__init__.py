@@ -15,3 +15,11 @@ Layer map (mirrors reference SURVEY.md §1, redesigned TPU-first):
 """
 
 __version__ = "0.1.0"
+
+import jax as _jax
+
+# Coordinate math (affine resampling, distance matrices, model fits) needs
+# full f32: TPU matmuls otherwise default to bf16 passes whose ~0.2% relative
+# error is pixels at volume scale. This is imaging, not ML training — always
+# run matmuls/einsums at highest precision (f32 on MXU via 3-pass bf16).
+_jax.config.update("jax_default_matmul_precision", "highest")

@@ -80,3 +80,78 @@ def detect_interestpoints_cmd(xml, dry_run, **kw):
     save_detections(sd, store, detections, params)
     sd.save(xml)
     print(f"saved interest points '{params.label}' + XML")
+
+
+@click.command()
+@xml_option
+@view_selection_options
+@infrastructure_options
+@click.option("-l", "--label", default="beads", help="interest point label")
+@click.option("-m", "--method", default="FAST_ROTATION",
+              type=click.Choice(["FAST_ROTATION", "FAST_TRANSLATION",
+                                 "PRECISE_TRANSLATION", "ICP"]),
+              help="matching method (SparkGeometricDescriptorMatching enum)")
+@click.option("--transformationModel", "model", default="AFFINE",
+              type=click.Choice(["TRANSLATION", "RIGID", "AFFINE"]))
+@click.option("--regularizationModel", "reg", default="RIGID",
+              type=click.Choice(["NONE", "IDENTITY", "TRANSLATION",
+                                 "RIGID", "AFFINE"]))
+@click.option("--lambda", "lam", default=0.1, type=float,
+              help="regularization weight")
+@click.option("-rtp", "--registrationTP", "registration_tp",
+              default="TIMEPOINTS_INDIVIDUALLY",
+              type=click.Choice(["TIMEPOINTS_INDIVIDUALLY", "ALL_TO_ALL",
+                                 "ALL_TO_ALL_WITH_RANGE", "REFERENCE_TIMEPOINT"]))
+@click.option("--referenceTP", "reference_tp", default=0, type=int)
+@click.option("--rangeTP", "range_tp", default=5, type=int)
+@click.option("--significance", "ratio_of_distance", default=3.0, type=float,
+              help="descriptor ratio-of-distance threshold")
+@click.option("--numNeighbors", "n_neighbors", default=3, type=int)
+@click.option("--redundancy", "redundancy", default=1, type=int)
+@click.option("--ransacIterations", default=10000, type=int)
+@click.option("--ransacMaxEpsilon", default=5.0, type=float)
+@click.option("--ransacMinInlierRatio", default=0.1, type=float)
+@click.option("--ransacMinNumInliers", default=12, type=int)
+@click.option("--icpMaxDistance", default=2.5, type=float)
+@click.option("--icpMaxIterations", default=200, type=int)
+@click.option("--interestPointsForOverlapOnly", "overlap_only_points",
+              is_flag=True, help="match only points inside the pair overlap")
+@click.option("--clearCorrespondences", "clear_corrs", is_flag=True,
+              help="drop existing correspondences instead of merging")
+def match_interestpoints_cmd(xml, dry_run, **kw):
+    """Distributed pairwise interest-point matching
+    (SparkGeometricDescriptorMatching)."""
+    from ..io.interestpoints import InterestPointStore
+    from ..models.matching import (
+        MatchingParams,
+        match_interest_points,
+        save_matches,
+    )
+
+    sd = load_project(xml)
+    views = select_views_from_kwargs(sd, kw)
+    params = MatchingParams(
+        label=kw["label"], method=kw["method"], model=kw["model"],
+        regularization=kw["reg"], lam=kw["lam"],
+        n_neighbors=kw["n_neighbors"], redundancy=kw["redundancy"],
+        ratio_of_distance=kw["ratio_of_distance"],
+        ransac_iterations=kw["ransaciterations"],
+        ransac_max_epsilon=kw["ransacmaxepsilon"],
+        ransac_min_inlier_ratio=kw["ransacmininlierratio"],
+        ransac_min_inliers=kw["ransacminnuminliers"],
+        icp_max_distance=kw["icpmaxdistance"],
+        icp_max_iterations=kw["icpmaxiterations"],
+        registration_tp=kw["registration_tp"],
+        reference_tp=kw["reference_tp"], range_tp=kw["range_tp"],
+        interest_points_for_overlap_only=kw["overlap_only_points"],
+        clear_correspondences=kw["clear_corrs"],
+    )
+    store = InterestPointStore.for_project(sd)
+    results = match_interest_points(sd, views, params, store)
+    total = sum(len(r.ids_a) for r in results)
+    print(f"matched {total} correspondences over {len(results)} pairs")
+    if dry_run:
+        print("dryRun: not saving")
+        return
+    save_matches(sd, store, results, params, views)
+    print("saved correspondences")

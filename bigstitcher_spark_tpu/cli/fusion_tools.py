@@ -183,9 +183,13 @@ def _write_bdv_output_xml(xml_out: str, container: str, meta, storage_format) ->
               help="process only this channel index of the container")
 @click.option("--timepointIndex", "timepoint_index", type=int, default=None,
               help="process only this timepoint index of the container")
+@click.option("--intensityN5", "intensity_n5", default=None, is_flag=False,
+              flag_value="",
+              help="apply solved intensity coefficients (optionally give the "
+                   "N5 path; default: intensity.n5 next to the input XML)")
 def affine_fusion_cmd(output, fusion_type, block_scale, masks, mask_offset,
                       blending_range, blending_border, channel_index,
-                      timepoint_index, dry_run, **kwargs):
+                      timepoint_index, intensity_n5, dry_run, **kwargs):
     """Fuse all views into the prepared container (THE workload)."""
     t_start = time.time()
     store = ChunkStore.open(output)
@@ -198,6 +202,20 @@ def affine_fusion_cmd(output, fusion_type, block_scale, masks, mask_offset,
     sd = SpimData.load(meta.input_xml)
     loader = ViewLoader(sd)
     all_views = select_views_from_kwargs(sd, kwargs)
+
+    coefficients = None
+    if intensity_n5 is not None:
+        from ..models.intensity import IntensityStore
+
+        istore = (IntensityStore(intensity_n5) if intensity_n5
+                  else IntensityStore.for_project(sd))
+        coefficients = {}
+        for v in all_views:
+            c = istore.load_coefficients(v)
+            if c is not None:
+                coefficients[v] = c.astype(np.float32)
+        click.echo(f"intensity correction: coefficients for "
+                   f"{len(coefficients)}/{len(all_views)} views from {istore.root}")
 
     blend = BlendParams(
         border=tuple(float(v) for v in blending_border.split(",")),
@@ -248,6 +266,7 @@ def affine_fusion_cmd(output, fusion_type, block_scale, masks, mask_offset,
                 masks=masks,
                 mask_offset=moff,
                 zarr_ct=(ci, ti) if is_zarr5d else None,
+                coefficients=coefficients,
             )
             total_vox += stats.voxels
             click.echo(f"  {stats.voxels} voxels in {stats.seconds:.2f}s "
@@ -266,3 +285,99 @@ def _write_pyramid(store, mr_levels, is_zarr5d, ct):
     for lvl in range(1, len(mr_levels)):
         downsample_pyramid_level(store, mr_levels[lvl - 1], mr_levels[lvl],
                                  is_zarr5d, ct)
+
+
+@click.command()
+@infrastructure_options
+@click.option("-o", "--output", "output", required=True,
+              help="fusion container created by create-fusion-container")
+@view_selection_options
+@click.option("-l", "--label", "labels", multiple=True, default=("beads",),
+              help="interest point label(s) defining the deformation")
+@click.option("-cpd", "--controlPointDistance", "cpd", type=float, default=10.0,
+              help="control point grid spacing in px")
+@click.option("--alpha", type=float, default=1.0,
+              help="inverse-distance weight exponent")
+@click.option("--fusionType", "fusion_type",
+              type=click.Choice(FUSION_TYPES, case_sensitive=False),
+              default="AVG_BLEND")
+@click.option("--blockScale", "block_scale", default="2,2,1")
+@click.option("--blendingRange", "blending_range", default="40,40,40")
+@click.option("--blendingBorder", "blending_border", default="0,0,0")
+@click.option("--channelIndex", "channel_index", type=int, default=None)
+@click.option("--timepointIndex", "timepoint_index", type=int, default=None)
+def nonrigid_fusion_cmd(output, labels, cpd, alpha, fusion_type, block_scale,
+                        blending_range, blending_border, channel_index,
+                        timepoint_index, dry_run, **kwargs):
+    """Distributed non-rigid fusion driven by corresponding interest points
+    (SparkNonRigidFusion)."""
+    from ..io.interestpoints import InterestPointStore
+    from ..models.nonrigid_fusion import (
+        build_unique_points,
+        fuse_nonrigid_volume,
+    )
+
+    t_start = time.time()
+    store = ChunkStore.open(output)
+    try:
+        meta = read_container_meta(store)
+    except ValueError as e:
+        raise click.ClickException(str(e)) from e
+    sd = SpimData.load(meta.input_xml)
+    loader = ViewLoader(sd)
+    all_views = select_views_from_kwargs(sd, kwargs)
+    ip_store = InterestPointStore.for_project(sd)
+
+    blend = BlendParams(
+        border=tuple(float(v) for v in blending_border.split(",")),
+        range=tuple(float(v) for v in blending_range.split(",")),
+    )
+    bscale = parse_csv_ints(block_scale, 3)
+    is_zarr5d = meta.fusion_format in ("OME-ZARR", "BDV/OME-ZARR")
+    channels = sorted({sd.setups[v.setup].attributes.get("channel", 0)
+                       for v in all_views})
+    tps = sorted({v.timepoint for v in all_views})
+    c_indices = ([channel_index] if channel_index is not None
+                 else list(range(len(channels))))
+    t_indices = ([timepoint_index] if timepoint_index is not None
+                 else list(range(len(tps))))
+
+    total_vox = 0
+    for ti in t_indices:
+        t = tps[ti]
+        for ci in c_indices:
+            c = channels[ci]
+            views = [
+                v for v in all_views
+                if v.timepoint == t
+                and sd.setups[v.setup].attributes.get("channel", 0) == c
+            ]
+            if not views:
+                continue
+            # deformation may use IPs of ALL views of this timepoint
+            # (corresponding views need not be restricted to the channel)
+            ip_views = [v for v in all_views if v.timepoint == t]
+            unique = build_unique_points(sd, ip_store, ip_views, list(labels))
+            mr = meta.mr_infos[ci + ti * meta.num_channels]
+            ds = store.open_dataset(mr[0].dataset.strip("/"))
+            click.echo(f"nonrigid fusing channel {c} timepoint {t}: "
+                       f"{len(views)} views -> {mr[0].dataset}")
+            if dry_run:
+                continue
+            stats = fuse_nonrigid_volume(
+                sd, loader, views, unique, ds, meta.bbox,
+                block_size=tuple(meta.block_size), block_scale=tuple(bscale),
+                cpd=cpd, alpha=alpha,
+                fusion_type=fusion_type.upper(), blend=blend,
+                anisotropy_factor=(meta.anisotropy_factor
+                                   if meta.preserve_anisotropy else float("nan")),
+                out_dtype=meta.data_type,
+                min_intensity=meta.min_intensity,
+                max_intensity=meta.max_intensity,
+                zarr_ct=(ci, ti) if is_zarr5d else None,
+            )
+            total_vox += stats.voxels
+            click.echo(f"  {stats.voxels} voxels in {stats.seconds:.2f}s")
+            if len(mr) > 1 and not dry_run:
+                _write_pyramid(store, mr, is_zarr5d, (ci, ti))
+    click.echo(f"done, {total_vox} voxels, took {time.time() - t_start:.1f}s")

@@ -1,0 +1,94 @@
+"""CLI: match-intensities and solve-intensities (reference tools
+SparkIntensityMatching.java / IntensitySolver.java)."""
+
+from __future__ import annotations
+
+import click
+
+from .common import (
+    infrastructure_options,
+    load_project,
+    parse_csv_ints,
+    select_views_from_kwargs,
+    view_selection_options,
+    xml_option,
+)
+
+
+@click.command()
+@xml_option
+@view_selection_options
+@infrastructure_options
+@click.option("--coefficients", "coefficients", default="8,8,8",
+              help="coefficient grid cells per view, e.g. 8,8,8")
+@click.option("--renderScale", "render_scale", type=float, default=0.25,
+              help="sampling scale inside overlaps")
+@click.option("-m", "--method", type=click.Choice(["RANSAC", "HISTOGRAM"]),
+              default="RANSAC")
+@click.option("--ransacEpsilon", "ransac_epsilon", type=float, default=0.02)
+@click.option("--ransacIterations", "ransac_iterations", type=int, default=1000)
+@click.option("--minSamples", "min_samples", type=int, default=10)
+@click.option("--intensityN5", "intensity_n5", default=None,
+              help="output N5 (default: intensity.n5 next to the XML)")
+def match_intensities_cmd(xml, dry_run, coefficients, render_scale, method,
+                          ransac_epsilon, ransac_iterations, min_samples,
+                          intensity_n5, **kw):
+    """Pairwise per-cell intensity matching (SparkIntensityMatching)."""
+    from ..io.dataset_io import ViewLoader
+    from ..models.intensity import (
+        IntensityParams,
+        IntensityStore,
+        match_intensities,
+    )
+
+    sd = load_project(xml)
+    views = select_views_from_kwargs(sd, kw)
+    loader = ViewLoader(sd)
+    params = IntensityParams(
+        coefficients=tuple(parse_csv_ints(coefficients, 3)),
+        render_scale=render_scale, method=method,
+        ransac_epsilon=ransac_epsilon, ransac_iterations=ransac_iterations,
+        min_samples_per_cell=min_samples,
+    )
+    matches = match_intensities(sd, loader, views, params)
+    print(f"matched {len(matches)} coefficient-cell pairs")
+    if dry_run:
+        print("dryRun: not saving")
+        return
+    store = (IntensityStore(intensity_n5) if intensity_n5
+             else IntensityStore.for_project(sd))
+    store.save_matches(matches, params.coefficients)
+    print(f"saved matches to {store.root}")
+
+
+@click.command()
+@xml_option
+@view_selection_options
+@infrastructure_options
+@click.option("--lambda", "lam", type=float, default=0.1,
+              help="regularization toward identity")
+@click.option("--intensityN5", "intensity_n5", default=None,
+              help="N5 with matches (default: intensity.n5 next to the XML)")
+def solve_intensities_cmd(xml, dry_run, lam, intensity_n5, **kw):
+    """Global solve of per-view intensity coefficient grids (IntensitySolver)."""
+    from ..models.intensity import IntensityStore, solve_intensities
+
+    sd = load_project(xml)
+    views = select_views_from_kwargs(sd, kw)
+    store = (IntensityStore(intensity_n5) if intensity_n5
+             else IntensityStore.for_project(sd))
+    matches = store.load_all_matches()
+    dims = store.coefficient_dims()
+    if not matches or dims is None:
+        raise click.ClickException(
+            f"no intensity matches in {store.root}; run match-intensities first")
+    coeffs = solve_intensities(matches, views, dims, lam)
+    if dry_run:
+        for v, c in sorted(coeffs.items()):
+            print(f"  {v}: scale [{c[..., 0].min():.3f}, {c[..., 0].max():.3f}]"
+                  f" offset [{c[..., 1].min():.1f}, {c[..., 1].max():.1f}]")
+        print("dryRun: not saving")
+        return
+    for v, c in coeffs.items():
+        store.save_coefficients(v, c)
+    print(f"saved coefficients for {len(coeffs)} views to {store.root}")

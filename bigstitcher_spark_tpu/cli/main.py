@@ -12,9 +12,11 @@ import click
 from . import (
     detection_tools,
     fusion_tools,
+    intensity_tools,
     resave_tools,
     solver_tools,
     stitching_tools,
+    utility_tools,
 )
 
 
@@ -30,6 +32,14 @@ cli.add_command(resave_tools.downsample_cmd, "downsample")
 cli.add_command(stitching_tools.stitching_cmd, "stitching")
 cli.add_command(solver_tools.solver_cmd, "solver")
 cli.add_command(detection_tools.detect_interestpoints_cmd, "detect-interestpoints")
+cli.add_command(detection_tools.match_interestpoints_cmd, "match-interestpoints")
+cli.add_command(fusion_tools.nonrigid_fusion_cmd, "nonrigid-fusion")
+cli.add_command(utility_tools.clear_interestpoints_cmd, "clear-interestpoints")
+cli.add_command(utility_tools.clear_registrations_cmd, "clear-registrations")
+cli.add_command(utility_tools.transform_points_cmd, "transform-points")
+cli.add_command(utility_tools.split_images_cmd, "split-images")
+cli.add_command(intensity_tools.match_intensities_cmd, "match-intensities")
+cli.add_command(intensity_tools.solve_intensities_cmd, "solve-intensities")
 
 
 def register(module_names: list[str]) -> None:

@@ -1,0 +1,183 @@
+"""CLI: clear-interestpoints, clear-registrations, transform-points,
+split-images (reference tools ClearInterestPoints.java, ClearRegistrations.java,
+TransformPoints.java, SplitDatasets.java)."""
+
+from __future__ import annotations
+
+import click
+import numpy as np
+
+from .common import (
+    infrastructure_options,
+    load_project,
+    parse_csv_ints,
+    select_views_from_kwargs,
+    view_selection_options,
+    xml_option,
+)
+
+
+@click.command()
+@xml_option
+@view_selection_options
+@infrastructure_options
+@click.option("-l", "--label", default=None,
+              help="only this interest point label (default: all labels)")
+@click.option("--onlyCorrespondences", "only_corrs", is_flag=True,
+              help="delete only correspondences, keep the points")
+def clear_interestpoints_cmd(xml, dry_run, label, only_corrs, **kw):
+    """Delete interest points (or only correspondences) from XML + store
+    (ClearInterestPoints.java:92-117)."""
+    from ..io.interestpoints import InterestPointStore
+
+    sd = load_project(xml)
+    views = select_views_from_kwargs(sd, kw)
+    store = InterestPointStore.for_project(sd)
+    n = 0
+    for v in views:
+        labels = ([label] if label else list(sd.interest_points.get(v, {})))
+        for lab in labels:
+            if lab not in sd.interest_points.get(v, {}):
+                continue
+            if dry_run:
+                print(f"would clear {v} label {lab!r}")
+                continue
+            if only_corrs:
+                store.clear_correspondences(v, lab)
+            else:
+                store.remove_view(v, lab)
+                del sd.interest_points[v][lab]
+                if not sd.interest_points[v]:
+                    del sd.interest_points[v]
+            n += 1
+    what = "correspondences" if only_corrs else "interest points"
+    print(f"cleared {what} of {n} (view, label) entries")
+    if not dry_run:
+        sd.save(xml)
+
+
+@click.command()
+@xml_option
+@view_selection_options
+@infrastructure_options
+@click.option("--keep", type=int, default=None,
+              help="keep only the first N transformations "
+                   "(in order of application: calibration first)")
+@click.option("--remove", type=int, default=None,
+              help="remove the last N transformations (the most recent)")
+def clear_registrations_cmd(xml, dry_run, keep, remove, **kw):
+    """Remove view transforms from the XML (ClearRegistrations.java:74-101).
+
+    The chain is stored outermost-first: list index 0 is the LAST-applied
+    transform, so --remove pops from the front and --keep pops the front
+    until N remain."""
+    if (keep is None) == (remove is None) or (keep or 0) < 0 or (remove or 0) < 0:
+        raise click.ClickException("specify exactly one of --keep / --remove, >= 0")
+    sd = load_project(xml)
+    views = select_views_from_kwargs(sd, kw)
+    for v in views:
+        chain = sd.registrations.get(v)
+        if not chain:
+            continue
+        if remove is not None:
+            drop = chain[: min(remove, len(chain))]
+        else:
+            drop = chain[: max(len(chain) - keep, 0)]
+        for t in drop:
+            print(f"{v}: removing {t.name!r}")
+        sd.registrations[v] = chain[len(drop):]
+    if not dry_run:
+        sd.save(xml)
+        print("saved XML")
+
+
+@click.command()
+@xml_option
+@infrastructure_options
+@click.option("-vi", "vi", required=True,
+              help="view 'timepoint,setup' whose transform chain to apply")
+@click.option("-p", "--point", "points", multiple=True,
+              help="input point 'x,y,z' (repeatable)")
+@click.option("--csvIn", "csv_in", default=None, type=click.Path(exists=True),
+              help="CSV file with x,y,z rows")
+@click.option("--csvOut", "csv_out", default=None,
+              help="write transformed points to this CSV instead of stdout")
+def transform_points_cmd(xml, dry_run, vi, points, csv_in, csv_out):
+    """Apply a view's full pixel->world affine chain to 3-D points
+    (TransformPoints.java:71-134)."""
+    from ..io.spimdata import ViewId
+    from ..utils.geometry import apply_affine
+
+    sd = load_project(xml)
+    tp, setup = (int(v) for v in vi.split(","))
+    view = ViewId(tp, setup)
+    if view not in sd.registrations:
+        raise click.ClickException(f"view {view} has no registration")
+    pts = []
+    for p in points:
+        pts.append([float(v) for v in p.split(",")])
+    if csv_in:
+        with open(csv_in) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                pts.append([float(v) for v in line.replace(";", ",").split(",")[:3]])
+    if not pts:
+        raise click.ClickException("no points given (-p or --csvIn)")
+    out = apply_affine(sd.model(view), np.asarray(pts, np.float64))
+    lines = [",".join(repr(float(v)) for v in row) for row in out]
+    if csv_out and not dry_run:
+        with open(csv_out, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"wrote {len(lines)} transformed points to {csv_out}")
+    else:
+        for src, dst in zip(pts, lines):
+            print(f"{tuple(src)} -> {dst}")
+
+
+@click.command()
+@xml_option
+@infrastructure_options
+@click.option("--xmlout", "xml_out", default=None,
+              help="output XML (default: overwrite input)")
+@click.option("-s", "--targetSize", "target_size", default="4000,4000,2000",
+              help="target sub-image size x,y,z (SplitDatasets defaults)")
+@click.option("-o", "--targetOverlap", "target_overlap", default="200,200,100",
+              help="target sub-image overlap x,y,z")
+@click.option("--assignIlluminations", "assign_illums", is_flag=True,
+              help="store old tile ids as illumination ids")
+@click.option("--fakeInterestPoints", "fake_ips", is_flag=True,
+              help="plant corresponding fake points in split overlaps")
+@click.option("--fipDensity", "fip_density", type=float, default=100.0)
+@click.option("--fipMinNumPoints", "fip_min", type=int, default=20)
+@click.option("--fipMaxNumPoints", "fip_max", type=int, default=500)
+@click.option("--fipError", "fip_error", type=float, default=0.5)
+def split_images_cmd(xml, dry_run, xml_out, target_size, target_overlap,
+                     assign_illums, fake_ips, fip_density, fip_min, fip_max,
+                     fip_error):
+    """Virtually split large tiles into overlapping sub-tiles
+    (SplitDatasets / SplittingTools.splitImages)."""
+    from ..io.dataset_io import ViewLoader
+    from ..io.interestpoints import InterestPointStore
+    from ..models.splitting import split_images
+
+    sd = load_project(xml)
+    loader = ViewLoader(sd)
+    store = InterestPointStore.for_project(sd) if fake_ips else None
+    new_sd = split_images(
+        sd, loader,
+        tuple(parse_csv_ints(target_size, 3)),
+        tuple(parse_csv_ints(target_overlap, 3)),
+        assign_illuminations=assign_illums,
+        fake_interest_points=fake_ips,
+        fip_density=fip_density, fip_min=fip_min, fip_max=fip_max,
+        fip_error=fip_error, fip_store=store,
+    )
+    print(f"split {len(sd.setups)} setups into {len(new_sd.setups)} sub-views")
+    if dry_run:
+        print("dryRun: not saving")
+        return
+    out = xml_out or xml
+    new_sd.save(out)
+    print(f"saved {out}")

@@ -64,6 +64,24 @@ def create_bdv_view_datasets(
     return out
 
 
+class _CropDataset:
+    """Read-only window into a source dataset — virtual split views
+    (models.splitting; role of the reference's SplitViewerImgLoader)."""
+
+    def __init__(self, ds: Dataset, offset, shape):
+        self._ds = ds
+        self._off = tuple(int(v) for v in offset)
+        self.shape = tuple(int(v) for v in shape)
+        self.dtype = ds.dtype
+
+    def read(self, offset, shape):
+        src_off = [o + d for o, d in zip(self._off, offset)]
+        return self._ds.read(src_off, shape)
+
+    def read_full(self):
+        return self._ds.read(self._off, self.shape)
+
+
 class ViewLoader:
     """Opens view images of a SpimData project (bdv.n5 loader equivalent)."""
 
@@ -80,23 +98,42 @@ class ViewLoader:
         self._factors_cache: dict[int, list[list[int]]] = {}
 
     def downsampling_factors(self, setup: int) -> list[list[int]]:
-        if setup not in self._factors_cache:
-            f = self.store.get_attribute(f"setup{setup}", "downsamplingFactors")
-            self._factors_cache[setup] = [
+        # split sub-views share the SOURCE setup's stored pyramid; source ids
+        # live in the container's namespace (they may collide with sub-view
+        # ids, so resolve against the store directly — no recursion)
+        split = self.sd.split_info.get(setup)
+        src = split[0] if split is not None else setup
+        if src not in self._factors_cache:
+            f = self.store.get_attribute(f"setup{src}", "downsamplingFactors")
+            self._factors_cache[src] = [
                 [int(v) for v in row] for row in (f or [[1, 1, 1]])
             ]
-        return self._factors_cache[setup]
+        return self._factors_cache[src]
 
     def num_levels(self, setup: int) -> int:
         return len(self.downsampling_factors(setup))
 
-    def open(self, view: ViewId, level: int = 0) -> Dataset:
-        key = (view.setup, view.timepoint, level)
+    def _open_raw(self, setup: int, timepoint: int, level: int) -> Dataset:
+        key = (setup, timepoint, level)
         if key not in self._cache:
             self._cache[key] = self.store.open_dataset(
-                bdv_dataset_path(view.setup, view.timepoint, level)
+                bdv_dataset_path(setup, timepoint, level)
             )
         return self._cache[key]
+
+    def open(self, view: ViewId, level: int = 0) -> Dataset:
+        split = self.sd.split_info.get(view.setup)
+        if split is not None:
+            src_setup, off = split
+            src = self._open_raw(src_setup, view.timepoint, level)
+            f = self.downsampling_factors(view.setup)[level]
+            size = self.sd.view_size(view)
+            return _CropDataset(
+                src,
+                [int(o) // int(ff) for o, ff in zip(off, f)],
+                [max(1, int(s) // int(ff)) for s, ff in zip(size, f)],
+            )
+        return self._open_raw(view.setup, view.timepoint, level)
 
     def mipmap_transform(self, setup: int, level: int) -> np.ndarray:
         return mipmap_transform(self.downsampling_factors(setup)[level])

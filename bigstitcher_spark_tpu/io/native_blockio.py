@@ -1,0 +1,117 @@
+"""ctypes binding for the native N5 block codec (native/blockio.cpp).
+
+Optional fast path: ctypes foreign calls release the GIL, so a Python thread
+pool over ``write_block``/``read_block`` encodes (zstd) and writes chunks
+truly in parallel — the role the reference fills with prebuilt codec JNI libs
+(N5Util.java:82-105, SURVEY.md §2.3). Falls back cleanly when the shared
+library has not been built (``make -C native``); callers must check
+``available()``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                        "native", "libblockio.so")
+_SRC_DIR = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                        "native")
+
+COMPRESSION = {"raw": 0, "zstd": 1}
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    so = os.path.abspath(_SO_PATH)
+    if not os.path.exists(so):
+        try:  # build on first use; the toolchain is baked into the image
+            subprocess.run(["make", "-C", os.path.abspath(_SRC_DIR)],
+                           check=True, capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.n5_encode_bound.restype = ctypes.c_int64
+    lib.n5_encode_bound.argtypes = [ctypes.c_int64, ctypes.c_int32]
+    lib.n5_write_block_file.restype = ctypes.c_int64
+    lib.n5_write_block_file.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.n5_read_block_file.restype = ctypes.c_int64
+    lib.n5_read_block_file.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def write_block(
+    block_path: str,
+    data: np.ndarray,
+    compression: str = "zstd",
+    level: int = 3,
+) -> None:
+    """Encode ``data`` (xyz-first logical order) as an N5 block file.
+
+    ``data`` axes follow the store convention (first axis fastest on disk),
+    so the buffer handed to C must be Fortran-contiguous w.r.t. that order.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native blockio not available")
+    arr = np.asfortranarray(data)
+    dims = (ctypes.c_uint32 * arr.ndim)(*arr.shape)
+    got = lib.n5_write_block_file(
+        block_path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+        arr.dtype.itemsize, dims, arr.ndim, arr.size,
+        COMPRESSION[compression], level,
+    )
+    if got < 0:
+        raise IOError(f"n5_write_block_file({block_path}) failed: {got}")
+
+
+def read_block(
+    block_path: str,
+    dtype: np.dtype,
+    max_shape: tuple[int, ...],
+    compression: str = "zstd",
+) -> np.ndarray | None:
+    """Decode one N5 block file -> xyz-first array, or None if absent."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native blockio not available")
+    dtype = np.dtype(dtype)
+    cap = int(np.prod(max_shape)) * dtype.itemsize
+    out = np.empty(int(np.prod(max_shape)), dtype=dtype)
+    dims = (ctypes.c_uint32 * 16)()
+    ndim = ctypes.c_int32()
+    got = lib.n5_read_block_file(
+        block_path.encode(), dtype.itemsize, COMPRESSION[compression],
+        out.ctypes.data_as(ctypes.c_void_p), cap, dims, ctypes.byref(ndim),
+    )
+    if got == -7:
+        return None
+    if got < 0:
+        raise IOError(f"n5_read_block_file({block_path}) failed: {got}")
+    shape = tuple(int(dims[d]) for d in range(ndim.value))
+    return out[: int(np.prod(shape))].reshape(shape, order="F")

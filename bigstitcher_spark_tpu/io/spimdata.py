@@ -147,6 +147,9 @@ class SpimData:
         self.interest_points: dict[ViewId, dict[str, InterestPointLookup]] = {}
         self.bounding_boxes: dict[str, Interval] = {}
         self.stitching_results: dict[tuple, PairwiseStitchingResult] = {}
+        # virtual split views: new setup id -> (source setup id, pixel offset)
+        # (role of the reference's SplitViewerImgLoader; models.splitting)
+        self.split_info: dict[int, tuple[int, tuple[int, int, int]]] = {}
         self._unknown_sections: list[ET.Element] = []
         self.xml_path: str | None = None  # where this project was loaded from
 
@@ -240,9 +243,18 @@ class SpimData:
                 res = _parse_pairwise_result(el)
                 sd.stitching_results[res.pair_key] = res
 
+        si = root.find("SplitInfo")
+        if si is not None:
+            for el in si.findall("Split"):
+                sd.split_info[int(el.get("setup"))] = (
+                    int(el.get("source")),
+                    tuple(int(v) for v in el.get("offset").split()),
+                )
+
         known = {
             "BasePath", "SequenceDescription", "ViewRegistrations",
             "ViewInterestPoints", "BoundingBoxes", "StitchingResults",
+            "SplitInfo",
         }
         for child in root:
             if child.tag not in known:
@@ -368,6 +380,12 @@ class SpimData:
 
         root.append(copy.deepcopy(preserved.pop(
             "IntensityAdjustments", ET.Element("IntensityAdjustments"))))
+
+        if self.split_info:
+            si = ET.SubElement(root, "SplitInfo")
+            for setup, (src, off) in sorted(self.split_info.items()):
+                ET.SubElement(si, "Split", setup=str(setup), source=str(src),
+                              offset=" ".join(str(v) for v in off))
 
         for el in preserved.values():
             root.append(copy.deepcopy(el))

@@ -134,10 +134,15 @@ def fuse_grid_block(
     compute_block_shape: tuple[int, ...] | None = None,
     stats: FusionStats | None = None,
     inside_offset: tuple[float, float, float] = (0.0, 0.0, 0.0),
+    coefficients: dict[ViewId, np.ndarray] | None = None,
 ) -> tuple[np.ndarray, np.ndarray] | None:
     """Fuse one grid block. Returns (fused f32, weight f32) arrays of
     ``block.size``, or None when no view overlaps (block left empty —
-    reference skips saving empty blocks)."""
+    reference skips saving empty blocks).
+
+    ``coefficients``: optional per-view (cx,cy,cz,2) intensity-correction
+    grids (BlkAffineFusion.initWithIntensityCoefficients role); forces the
+    general gather kernel."""
     blend = blend or BlendParams()
     bshape = tuple(compute_block_shape or block.size)
     block_global = Interval.from_shape(bshape, block.offset).translate(bbox.min)
@@ -145,7 +150,7 @@ def fuse_grid_block(
     if not plans:
         return None
 
-    if all(p.is_translation for p in plans):
+    if coefficients is None and all(p.is_translation for p in plans):
         return _fuse_shift_path(
             loader, plans, block, block_global, bshape, fusion_type, blend,
             stats, inside_offset,
@@ -175,13 +180,35 @@ def fuse_grid_block(
         ranges[i] = np.asarray(blend.range) / np.asarray(factors, dtype=np.float64)
         valid[i] = 1.0
 
+    coeffs = coeff_affs = None
+    if coefficients is not None:
+        cdims = next(iter(coefficients.values())).shape[:3]
+        coeffs = np.zeros((vb, *cdims, 2), np.float32)
+        coeffs[..., 0] = 1.0
+        coeff_affs = np.zeros((vb, 3, 4), np.float32)
+        coeff_affs[:, :, :3] = np.eye(3)
+        for i, p in enumerate(plans):
+            grid = coefficients.get(p.view)
+            if grid is None:
+                continue
+            coeffs[i] = grid
+            # level coords -> grid coords: full-res px = f*l + (f-1)/2; cell
+            # centers at (k+0.5)*cs - 0.5 with cs = view_size/dims
+            f = np.asarray(loader.downsampling_factors(p.view.setup)[p.level],
+                           np.float64)
+            cs = np.array(sd.view_size(p.view), np.float64) / np.array(cdims)
+            coeff_affs[i, :, :3] = np.diag(f / cs)
+            coeff_affs[i, :, 3] = ((f - 1) / 2.0 + 0.5) / cs - 0.5
+
     if stats is not None:
-        stats.compile_keys.add((bshape, pshape, vb, fusion_type))
+        stats.compile_keys.add((bshape, pshape, vb, fusion_type,
+                                coefficients is not None))
     ioffs = np.tile(np.asarray(inside_offset, np.float32), (vb, 1))
     with profiling.span("fusion.kernel"):
         fused, wsum = F.fuse_block(
             patches, affines, offsets, img_dims, borders, ranges, valid,
             block_shape=bshape, fusion_type=fusion_type, inside_offs=ioffs,
+            coeffs=coeffs, coeff_affines=coeff_affs,
         )
         fused, wsum = np.asarray(fused), np.asarray(wsum)
     # crop the static compute shape back to the (possibly clipped) block
@@ -353,11 +380,13 @@ def fuse_volume(
     mask_offset: tuple[float, float, float] = (0.0, 0.0, 0.0),
     zarr_ct: tuple[int, int] | None = None,
     progress: bool = False,
+    coefficients: dict[ViewId, np.ndarray] | None = None,
 ) -> FusionStats:
     """Fuse ``views`` into ``out_ds`` over ``bbox``.
 
     ``zarr_ct``: (channel, timepoint) indices when out_ds is a 5-D OME-ZARR
     dataset (3-D block embedded at [...,c,t], SparkAffineFusion.java:630-651).
+    ``coefficients``: per-view intensity-correction grids (models.intensity).
     """
     stats = FusionStats()
     t0 = time.time()
@@ -372,7 +401,7 @@ def fuse_volume(
         else:
             min_intensity, max_intensity = 0.0, 1.0
 
-    vol = _try_fuse_volume_device(
+    vol = None if coefficients is not None else _try_fuse_volume_device(
         sd, loader, views, bbox, block_size, block_scale, fusion_type,
         blend or BlendParams(), aniso, out_dtype, min_intensity,
         max_intensity, masks, stats, mask_offset=mask_offset,
@@ -394,6 +423,7 @@ def fuse_volume(
             sd, loader, views, block, bbox, fusion_type, blend, aniso,
             compute_block_shape=compute_block, stats=stats,
             inside_offset=mask_offset if masks else (0.0, 0.0, 0.0),
+            coefficients=coefficients,
         )
         stats.blocks += 1
         if res is None:

@@ -1,0 +1,231 @@
+"""Pairwise interest-point matching driver: pair planning per timepoint
+policy, descriptor matching + RANSAC (or ICP), correspondence storage.
+
+TPU redesign of SparkGeometricDescriptorMatching (reference call stack
+SURVEY.md §3.4): the work list is overlapping view pairs (strategy P2); per
+pair, interest points are world-transformed under current registrations,
+candidate correspondences come from the batched descriptor kernels and are
+verified by hypothesis-parallel RANSAC (ops.descriptors). Inliers are stored
+symmetrically into interestpoints.n5 ``correspondences`` datasets — the
+exact format ``models.solver.matches_from_interest_points`` consumes.
+
+Reference parity notes: grouped matching (tile/channel/illum merging via
+InterestPointGroupingMinDistance, SparkGeometricDescriptorMatching.java:343-503)
+is not implemented yet — each view matches individually.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..io.interestpoints import CorrespondingPoint, InterestPointStore
+from ..io.spimdata import SpimData, ViewId
+from ..ops import descriptors as D
+from ..ops import models as M
+from ..utils.geometry import Interval, apply_affine, transformed_interval
+from .. import profiling
+
+INDIVIDUAL_TIMEPOINTS = "TIMEPOINTS_INDIVIDUALLY"
+ALL_TO_ALL = "ALL_TO_ALL"
+ALL_TO_ALL_RANGE = "ALL_TO_ALL_WITH_RANGE"
+REFERENCE_TIMEPOINT = "REFERENCE_TIMEPOINT"
+
+
+@dataclass
+class MatchingParams:
+    """Defaults follow the reference CLI
+    (SparkGeometricDescriptorMatching.java:82,180-189; AbstractRegistration.java:59-108)."""
+
+    label: str = "beads"
+    method: str = D.GEOMETRIC_HASHING   # FAST_ROTATION|FAST_TRANSLATION|PRECISE_TRANSLATION|ICP
+    model: str = M.AFFINE
+    regularization: str = M.RIGID
+    lam: float = 0.1
+    n_neighbors: int = 3
+    redundancy: int = 1
+    ratio_of_distance: float = 3.0
+    ransac_iterations: int = 10000
+    ransac_max_epsilon: float = 5.0
+    ransac_min_inlier_ratio: float = 0.1
+    ransac_min_inliers: int = 12
+    icp_max_distance: float = 2.5
+    icp_max_iterations: int = 200
+    registration_tp: str = INDIVIDUAL_TIMEPOINTS
+    reference_tp: int = 0
+    range_tp: int = 5
+    overlap_filter: bool = True          # SimpleBoundingBoxOverlap vs all-against-all
+    interest_points_for_overlap_only: bool = False
+    clear_correspondences: bool = False
+
+
+@dataclass
+class PairMatchResult:
+    view_a: ViewId
+    view_b: ViewId
+    ids_a: np.ndarray        # (K,) interest-point ids on A
+    ids_b: np.ndarray
+    model: np.ndarray | None
+    n_candidates: int
+
+
+def plan_match_pairs(
+    sd: SpimData, views: list[ViewId], params: MatchingParams
+) -> list[tuple[ViewId, ViewId]]:
+    """Enumerate view pairs per timepoint policy + overlap filter
+    (PairwiseSetup constellation, AbstractRegistration.java:143-179)."""
+    views = sorted(views)
+    boxes = {
+        v: transformed_interval(sd.model(v), Interval.from_shape(sd.view_size(v)))
+        for v in views
+    }
+    policy = params.registration_tp.upper()
+    out = []
+    for i in range(len(views)):
+        for j in range(i + 1, len(views)):
+            a, b = views[i], views[j]
+            ta, tb = a.timepoint, b.timepoint
+            if policy == INDIVIDUAL_TIMEPOINTS:
+                if ta != tb:
+                    continue
+            elif policy == ALL_TO_ALL_RANGE:
+                if abs(ta - tb) > params.range_tp:
+                    continue
+            elif policy == REFERENCE_TIMEPOINT:
+                # each timepoint registers against the reference timepoint
+                if not (ta == tb or params.reference_tp in (ta, tb)):
+                    continue
+            # ALL_TO_ALL: no timepoint restriction
+            if params.overlap_filter and not boxes[a].overlaps(boxes[b]):
+                continue
+            out.append((a, b))
+    return out
+
+
+def _filter_to_overlap(
+    sd: SpimData, ids, world, view: ViewId, other: ViewId
+) -> tuple[np.ndarray, np.ndarray]:
+    """Keep only points inside the pair's world overlap bbox (+epsilon)
+    (filterForOverlappingInterestPoints, SparkGeometricDescriptorMatching.java:294-305)."""
+    box_a = transformed_interval(sd.model(view), Interval.from_shape(sd.view_size(view)))
+    box_b = transformed_interval(sd.model(other), Interval.from_shape(sd.view_size(other)))
+    ov = box_a.intersect(box_b).expand(2)
+    if ov.is_empty() or not len(world):
+        return ids[:0], world[:0]
+    keep = np.all(
+        (world >= np.array(ov.min)) & (world <= np.array(ov.max)), axis=1
+    )
+    return ids[keep], world[keep]
+
+
+def match_pair(
+    wa: np.ndarray, wb: np.ndarray, params: MatchingParams, seed: int = 17
+) -> tuple[np.ndarray, np.ndarray | None, int]:
+    """Match two world-space point clouds.
+
+    Returns (inlier index pairs (K,2) into wa/wb, model 3x4 a->b or None,
+    n_candidates)."""
+    if params.method == D.ICP:
+        res = D.icp(
+            wa, wb, params.model, params.regularization, params.lam,
+            params.icp_max_distance, params.icp_max_iterations,
+        )
+        if res is None:
+            return np.zeros((0, 2), np.int32), None, 0
+        model, pairs = res
+        return pairs, model, len(pairs)
+
+    cand = D.match_candidates(
+        wa, wb, params.method, params.n_neighbors, params.redundancy,
+        params.ratio_of_distance,
+    )
+    if len(cand) == 0:
+        return np.zeros((0, 2), np.int32), None, 0
+    res = D.ransac(
+        wa[cand[:, 0]], wb[cand[:, 1]],
+        params.model, params.regularization, params.lam,
+        params.ransac_max_epsilon, params.ransac_min_inlier_ratio,
+        params.ransac_min_inliers, params.ransac_iterations, seed=seed,
+    )
+    if res is None:
+        return np.zeros((0, 2), np.int32), None, len(cand)
+    model, inliers = res
+    return cand[inliers], model, len(cand)
+
+
+def match_interest_points(
+    sd: SpimData,
+    views: list[ViewId],
+    params: MatchingParams | None = None,
+    store: InterestPointStore | None = None,
+    progress: bool = True,
+) -> list[PairMatchResult]:
+    """Run pairwise matching over all planned pairs; results are NOT yet
+    persisted (use ``save_matches``)."""
+    params = params or MatchingParams()
+    store = store or InterestPointStore.for_project(sd)
+    pairs = plan_match_pairs(sd, views, params)
+    if progress:
+        print(f"matching: {len(pairs)} view pairs, method {params.method}, "
+              f"model {params.model} reg {params.regularization} λ={params.lam}")
+
+    cache: dict[ViewId, tuple[np.ndarray, np.ndarray]] = {}
+
+    def world(view: ViewId):
+        if view not in cache:
+            ids, locs = store.load_points(view, params.label)
+            w = apply_affine(sd.model(view), locs) if len(locs) else locs
+            cache[view] = (ids, w)
+        return cache[view]
+
+    results = []
+    for k, (va, vb) in enumerate(pairs):
+        ids_a, wa = world(va)
+        ids_b, wb = world(vb)
+        if params.interest_points_for_overlap_only:
+            ids_a, wa = _filter_to_overlap(sd, ids_a, wa, va, vb)
+            ids_b, wb = _filter_to_overlap(sd, ids_b, wb, vb, va)
+        with profiling.span("matching.pair"):
+            inl, model, n_cand = match_pair(wa, wb, params, seed=17 + k)
+        res = PairMatchResult(
+            va, vb,
+            ids_a[inl[:, 0]] if len(inl) else np.zeros(0, np.uint64),
+            ids_b[inl[:, 1]] if len(inl) else np.zeros(0, np.uint64),
+            model, n_cand,
+        )
+        results.append(res)
+        if progress:
+            print(f"  {va} <-> {vb}: {len(inl)} inliers / {n_cand} candidates")
+    return results
+
+
+def save_matches(
+    sd: SpimData,
+    store: InterestPointStore,
+    results: list[PairMatchResult],
+    params: MatchingParams,
+    views: list[ViewId],
+) -> None:
+    """Persist correspondences symmetrically per view
+    (MatcherPairwiseTools.addCorrespondences + save,
+    SparkGeometricDescriptorMatching.java:509-545). Existing correspondences
+    of re-matched views are kept and merged unless clear_correspondences."""
+    label = params.label
+    new: dict[ViewId, list[CorrespondingPoint]] = {v: [] for v in views}
+    for r in results:
+        for ia, ib in zip(r.ids_a.astype(int), r.ids_b.astype(int)):
+            new.setdefault(r.view_a, []).append(
+                CorrespondingPoint(ia, r.view_b, label, ib))
+            new.setdefault(r.view_b, []).append(
+                CorrespondingPoint(ib, r.view_a, label, ia))
+    for v, corrs in new.items():
+        if not params.clear_correspondences:
+            existing = store.load_correspondences(v, label)
+            seen = {(c.id, c.other_view, c.other_label, c.other_id)
+                    for c in corrs}
+            corrs = corrs + [
+                c for c in existing
+                if (c.id, c.other_view, c.other_label, c.other_id) not in seen
+            ]
+        store.save_correspondences(v, label, corrs)

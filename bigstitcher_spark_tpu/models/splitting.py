@@ -1,0 +1,201 @@
+"""Virtual tile splitting: divide huge views into overlapping sub-views.
+
+Role of ``SplittingTools.splitImages`` + ``Split_Views`` used by the
+reference split-images tool (SplitDatasets.java:94-124): each view setup is
+replaced by a grid of sub-views of ~target size with ~target overlap (both
+rounded up to the mipmap step size so pyramid levels stay addressable), the
+registrations gain an innermost translation per sub-view, and optionally
+"fake" interest points with exact correspondences are planted in the
+sub-view overlaps so the solver keeps split pieces rigidly together.
+
+The split is VIRTUAL: no image data is rewritten. Sub-view reads resolve
+through ``SpimData.split_info`` (new setup -> source setup + pixel offset),
+which ``ViewLoader`` applies at every mipmap level. This framework
+serializes that mapping as a ``<SplitInfo>`` element in the XML — our own
+extension; the reference instead serializes its SplitViewerImgLoader.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.interestpoints import CorrespondingPoint, InterestPointStore, register_points_in_xml
+from ..io.spimdata import SpimData, ViewId, ViewSetup, ViewTransform
+from ..utils.geometry import Interval, translation_affine
+
+
+def closest_larger_divisible(value: int, step: int) -> int:
+    """Round up to a multiple of ``step`` (Split_Views.closestLargerLongDivisableBy)."""
+    step = max(int(step), 1)
+    value = int(value)
+    return value if value % step == 0 else (value // step + 1) * step
+
+
+def min_step_size(sd: SpimData, loader) -> np.ndarray:
+    """Per-axis step every split offset/size must be divisible by: the
+    coarsest mipmap factor over all setups (Split_Views.findMinStepSize)."""
+    step = np.ones(3, np.int64)
+    for sid in sd.setups:
+        for f in loader.downsampling_factors(sid):
+            step = np.maximum(step, np.asarray(f, np.int64))
+    return step
+
+
+def _axis_starts(dim: int, size: int, overlap: int) -> list[int]:
+    """Sub-interval start offsets covering [0,dim): stride size-overlap, the
+    last interval clamped so coverage is exact."""
+    if size >= dim:
+        return [0]
+    stride = max(size - overlap, 1)
+    starts = list(range(0, dim - size + 1, stride))
+    if starts[-1] + size < dim:
+        starts.append(dim - size)
+    return starts
+
+
+def split_images(
+    sd: SpimData,
+    loader,
+    target_size: tuple[int, int, int],
+    target_overlap: tuple[int, int, int],
+    assign_illuminations: bool = False,
+    fake_interest_points: bool = False,
+    fip_density: float = 100.0,       # points per 100^3 px of overlap volume
+    fip_min: int = 20,
+    fip_max: int = 500,
+    fip_error: float = 0.5,
+    fip_store: InterestPointStore | None = None,
+    rng_seed: int = 23,
+) -> SpimData:
+    """Build a new virtually-split project (the input is not modified)."""
+    step = min_step_size(sd, loader)
+    size = np.array([closest_larger_divisible(target_size[d], step[d])
+                     for d in range(3)], np.int64)
+    overlap = np.array([closest_larger_divisible(target_overlap[d], step[d])
+                        for d in range(3)], np.int64)
+    if np.any(overlap > size):
+        raise ValueError(f"overlap {overlap} cannot exceed size {size}")
+
+    out = SpimData()
+    # absolute loader path: the split XML may be saved anywhere
+    from ..io.spimdata import ImageLoader
+
+    out.image_loader = ImageLoader(
+        format=sd.image_loader.format,
+        path=sd.resolve_loader_path(),
+        path_type="absolute",
+    )
+    out.timepoints = list(sd.timepoints)
+    out.attributes = {k: dict(v) for k, v in sd.attributes.items()}
+    out.bounding_boxes = dict(sd.bounding_boxes)
+    from ..io.spimdata import AttributeEntity
+
+    out.attributes["tile"] = {}
+    if assign_illuminations:
+        out.attributes["illumination"] = {}
+
+    new_id = 0
+    tile_id = 0
+    sub_of_source: dict[int, list[tuple[int, np.ndarray, np.ndarray]]] = {}
+    for sid in sorted(sd.setups):
+        src = sd.setups[sid]
+        dims = np.asarray(src.size, np.int64)
+        sub_size = np.minimum(size, dims)
+        starts = [
+            _axis_starts(int(dims[d]), int(sub_size[d]), int(overlap[d]))
+            for d in range(3)
+        ]
+        subs = []
+        for sx in starts[0]:
+            for sy in starts[1]:
+                for sz in starts[2]:
+                    off = np.array([sx, sy, sz], np.int64)
+                    attrs = dict(src.attributes)
+                    attrs["tile"] = tile_id
+                    out.attributes["tile"][tile_id] = AttributeEntity(
+                        tile_id, str(tile_id))
+                    if assign_illuminations:
+                        illum = src.attributes.get("tile", 0)
+                        attrs["illumination"] = illum
+                        out.attributes["illumination"].setdefault(
+                            illum, AttributeEntity(illum, str(illum)))
+                    out.setups[new_id] = ViewSetup(
+                        id=new_id,
+                        name=f"{src.name or sid} split {tile_id}",
+                        size=tuple(int(v) for v in sub_size),
+                        attributes=attrs,
+                        voxel_size=src.voxel_size,
+                    )
+                    out.split_info[new_id] = (sid, tuple(int(v) for v in off))
+                    for t in sd.timepoints:
+                        vid = ViewId(t, sid)
+                        if vid not in sd.registrations:
+                            continue
+                        chain = [tr.copy() for tr in sd.registrations[vid]]
+                        # innermost (applied first): sub-view px -> source px
+                        chain.append(ViewTransform(
+                            "split offset",
+                            translation_affine(off.astype(np.float64)),
+                        ))
+                        out.registrations[ViewId(t, new_id)] = chain
+                    subs.append((new_id, off, sub_size.copy()))
+                    tile_id += 1
+                    new_id += 1
+        sub_of_source[sid] = subs
+
+    if fake_interest_points:
+        if fip_store is None:
+            raise ValueError("fake_interest_points requires fip_store")
+        _plant_fake_points(
+            sd, out, sub_of_source, fip_store,
+            fip_density, fip_min, fip_max, fip_error, rng_seed,
+        )
+    return out
+
+
+def _plant_fake_points(
+    sd, out, sub_of_source, store, density, fip_min, fip_max, error, seed,
+) -> None:
+    """Uniform random points in each overlap between sub-views of one source
+    view, identical up to ``error`` jitter, with exact correspondences —
+    solver glue holding split pieces together (SplittingTools fake IPs)."""
+    rng = np.random.default_rng(seed)
+    label = "splitPoints"
+    pts: dict[int, list[np.ndarray]] = {}
+    corrs: dict[int, list[tuple[int, int, int]]] = {}  # setup -> (id, other_setup, other_id)
+    for sid, subs in sub_of_source.items():
+        for i in range(len(subs)):
+            id_a, off_a, size_a = subs[i]
+            box_a = Interval.from_shape(size_a, off_a)
+            for j in range(i + 1, len(subs)):
+                id_b, off_b, size_b = subs[j]
+                box_b = Interval.from_shape(size_b, off_b)
+                if not box_a.overlaps(box_b):
+                    continue
+                ov = box_a.intersect(box_b)
+                vol = ov.num_elements
+                n = int(np.clip(density * vol / 1e6, fip_min, fip_max))
+                p_src = rng.uniform(np.array(ov.min, float),
+                                    np.array(ov.max, float) + 1.0, (n, 3))
+                jit = rng.normal(0.0, error, (n, 3)) if error > 0 else 0.0
+                la = pts.setdefault(id_a, [])
+                lb = pts.setdefault(id_b, [])
+                ca = corrs.setdefault(id_a, [])
+                cb = corrs.setdefault(id_b, [])
+                base_a, base_b = len(la), len(lb)
+                for k in range(n):
+                    la.append(p_src[k] - off_a)
+                    lb.append(p_src[k] + (jit[k] if error > 0 else 0.0) - off_b)
+                    ca.append((base_a + k, id_b, base_b + k))
+                    cb.append((base_b + k, id_a, base_a + k))
+    for t in out.timepoints:
+        for setup_id, plist in pts.items():
+            vid = ViewId(t, setup_id)
+            if vid not in out.registrations:
+                continue
+            grp = store.save_points(vid, label, np.array(plist))
+            register_points_in_xml(out, vid, label, "fake split points", grp)
+            store.save_correspondences(vid, label, [
+                CorrespondingPoint(pid, ViewId(t, other), label, oid)
+                for pid, other, oid in corrs.get(setup_id, [])
+            ])

@@ -88,15 +88,24 @@ def _blend_weight(
 
 
 def _sample_one_view(patch, affine, patch_offset, img_dim, border, blend_range,
-                     inside_off, coords):
+                     inside_off, coords, coeff=None, coeff_affine=None):
     """Per-view: transform block coords, sample, weight. Returns (val, w).
 
     ``inside_off`` expands (+) or shrinks (-) the image box used for the
     inside test — the reference's ``--maskOffset`` for masks mode
-    (GenerateComputeBlockMasks, fusion/GenerateComputeBlockMasks.java:84-177)."""
+    (GenerateComputeBlockMasks, fusion/GenerateComputeBlockMasks.java:84-177).
+    ``coeff`` (Cx,Cy,Cz,2): per-view intensity-correction grid [scale,offset]
+    sampled at ``coeff_affine @ lpos`` — mvrecon Coefficients applied inside
+    the fusion kernel (SparkAffineFusion.java:545-559)."""
     p = coords @ affine[:, :3].T + affine[:, 3]  # patch coords (N,3)
     val = _trilinear_sample(patch, p)
     lpos = p + patch_offset  # level-image coords
+    if coeff is not None:
+        from .nonrigid import _trilinear_vec
+
+        g = lpos @ coeff_affine[:, :3].T + coeff_affine[:, 3]
+        so = _trilinear_vec(coeff, g)
+        val = so[:, 0] * val + so[:, 1]
     inside = jnp.all(
         (lpos >= -inside_off) & (lpos <= img_dim - 1.0 + inside_off), axis=-1
     ).astype(jnp.float32)
@@ -115,6 +124,8 @@ def fuse_block_impl(
     block_shape: tuple[int, int, int],
     fusion_type: str = "AVG_BLEND",
     inside_offs: jnp.ndarray | None = None,  # (V, 3) mask-offset expansion
+    coeffs: jnp.ndarray | None = None,       # (V, Cx,Cy,Cz, 2) intensity maps
+    coeff_affines: jnp.ndarray | None = None,  # (V, 3, 4) lpos -> grid coords
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fuse one output block. Returns (fused float32 block, weight-sum block).
 
@@ -123,10 +134,16 @@ def fuse_block_impl(
     if inside_offs is None:
         inside_offs = jnp.zeros_like(borders)
     coords = block_coords(block_shape)
-    vals, insides, wblends = jax.vmap(
-        _sample_one_view, in_axes=(0, 0, 0, 0, 0, 0, 0, None)
-    )(patches, affines, patch_offsets, img_dims, borders, blend_ranges,
-      inside_offs, coords)
+    if coeffs is None:
+        vals, insides, wblends = jax.vmap(
+            _sample_one_view, in_axes=(0, 0, 0, 0, 0, 0, 0, None)
+        )(patches, affines, patch_offsets, img_dims, borders, blend_ranges,
+          inside_offs, coords)
+    else:
+        vals, insides, wblends = jax.vmap(
+            _sample_one_view, in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0, 0)
+        )(patches, affines, patch_offsets, img_dims, borders, blend_ranges,
+          inside_offs, coords, coeffs, coeff_affines)
     fused, wsum = _combine_views(vals, insides, wblends, valid, fusion_type)
     return (fused.reshape(block_shape), wsum.reshape(block_shape))
 

@@ -1,0 +1,221 @@
+"""Intensity correction kernels: per-cell linear-map RANSAC matching and the
+global coefficient solve.
+
+Role of mvrecon ``IntensityCorrection.{matchRansac, matchHistograms, solve}``
+used at SparkIntensityMatching.java:171-183 and IntensitySolver.java:116-118:
+every view carries a coarse coefficient grid (default 8x8x8 cells); between
+two overlapping views, co-located intensity samples are collected per cell
+pair and a 1-D linear model i_B ~= a*i_A + b is RANSAC-fitted per cell pair;
+the global solve then finds per-cell (scale, offset) maps minimizing
+disagreement over all matched pairs, regularized toward identity.
+
+TPU design: cell-pair matches are a vmapped hypothesis-parallel RANSAC over
+a padded (pairs, samples) batch — one compile per bucket; the global solve
+is a Jacobi/conjugate-gradient pass over the quadratic form assembled from
+per-match sufficient statistics, all dense vectorized numpy (the system is
+tiny: 2 unknowns per cell).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# --------------------------------------------------------------------------
+# pairwise matching
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("iterations",))
+def _linefit_ransac_kernel(x, y, valid, key, epsilon, iterations):
+    """Batched over leading axis P: RANSAC a 1-D linear model y ~= a*x + b.
+
+    x,y: (P,N); valid: (P,N); returns (a (P,), b (P,), n_inliers (P,)).
+    """
+    P, N = x.shape
+
+    def per_pair(xp_, yp, vp, k):
+        idx = jax.random.randint(k, (iterations, 2), 0, N)
+        x0 = xp_[idx[:, 0]]
+        x1 = xp_[idx[:, 1]]
+        y0 = yp[idx[:, 0]]
+        y1 = yp[idx[:, 1]]
+        dx = x1 - x0
+        a = jnp.where(jnp.abs(dx) > 1e-6, (y1 - y0) / jnp.where(
+            jnp.abs(dx) > 1e-6, dx, 1.0), 1.0)
+        b = y0 - a * x0
+        err = jnp.abs(yp[None, :] - (a[:, None] * xp_[None, :] + b[:, None]))
+        inl = (err < epsilon) & (vp[None, :] > 0)
+        counts = inl.sum(-1)
+        best = jnp.argmax(counts)
+        w = inl[best].astype(jnp.float32)
+        # weighted least-squares refit on the best consensus set
+        sw = jnp.maximum(w.sum(), 1e-6)
+        mx = (w * xp_).sum() / sw
+        my = (w * yp).sum() / sw
+        cov = (w * (xp_ - mx) * (yp - my)).sum()
+        var = jnp.maximum((w * (xp_ - mx) ** 2).sum(), 1e-12)
+        a_f = cov / var
+        b_f = my - a_f * mx
+        return a_f, b_f, counts[best]
+
+    keys = jax.random.split(key, P)
+    return jax.vmap(per_pair)(x, y, valid, keys)
+
+
+def match_cells_ransac(
+    samples_a: list[np.ndarray],
+    samples_b: list[np.ndarray],
+    epsilon: float = 0.1,
+    min_inliers: int = 10,
+    iterations: int = 1000,
+    seed: int = 5,
+) -> list[tuple[float, float, int] | None]:
+    """RANSAC linear fits for a list of cell-pair sample sets.
+
+    samples_a[i], samples_b[i]: (N_i,) co-located intensities. Sample counts
+    are padded to the max bucket so ONE kernel serves the whole list. Entries
+    with < min_inliers consensus return None (IntensityCorrection.matchRansac
+    role; epsilon is relative to the intensity range).
+    """
+    P = len(samples_a)
+    if P == 0:
+        return []
+    n = max(8, 1 << int(np.ceil(np.log2(max(max(len(s) for s in samples_a), 2)))))
+    x = np.zeros((P, n), np.float32)
+    y = np.zeros((P, n), np.float32)
+    v = np.zeros((P, n), np.float32)
+    for i, (sa, sb) in enumerate(zip(samples_a, samples_b)):
+        m = len(sa)
+        x[i, :m] = sa
+        y[i, :m] = sb
+        v[i, :m] = 1.0
+    a, b, cnt = _linefit_ransac_kernel(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(v),
+        jax.random.PRNGKey(seed), jnp.float32(epsilon), int(iterations),
+    )
+    a, b, cnt = np.asarray(a), np.asarray(b), np.asarray(cnt)
+    out = []
+    for i in range(P):
+        if cnt[i] >= min_inliers and len(samples_a[i]) >= 2:
+            out.append((float(a[i]), float(b[i]), int(cnt[i])))
+        else:
+            out.append(None)
+    return out
+
+
+def match_cells_histogram(
+    samples_a: list[np.ndarray], samples_b: list[np.ndarray],
+    min_samples: int = 10,
+) -> list[tuple[float, float, int] | None]:
+    """Histogram-alignment alternative (IntensityCorrection.matchHistograms
+    role): fit the linear map aligning the two sample distributions by their
+    robust quantiles."""
+    out = []
+    for sa, sb in zip(samples_a, samples_b):
+        if len(sa) < min_samples:
+            out.append(None)
+            continue
+        qa = np.quantile(sa, [0.1, 0.9])
+        qb = np.quantile(sb, [0.1, 0.9])
+        if qa[1] - qa[0] < 1e-9:
+            out.append(None)
+            continue
+        a = (qb[1] - qb[0]) / (qa[1] - qa[0])
+        b = qb[0] - a * qa[0]
+        out.append((float(a), float(b), len(sa)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# global solve
+# --------------------------------------------------------------------------
+
+def solve_intensity_coefficients(
+    n_cells: int,
+    matches: list[tuple[int, int, float, float, float, float, float]],
+    lam: float = 0.1,
+    smooth_pairs: list[tuple[int, int]] | None = None,
+    smooth_weight: float = 0.5,
+) -> np.ndarray:
+    """Global least squares over the coefficient graph.
+
+    Unknowns: per cell c a map f_c(i) = s_c*i + o_c (2*n_cells unknowns,
+    cells indexed globally over all views). Each match contributes, for its
+    sample set {(x_k, y_k)} between cells (ca, cb), the residuals
+    f_ca(x_k) - f_cb(y_k) — passed in as sufficient statistics
+    (ca, cb, n, Sx, Sy, Sxx, Syy_plus_Sxy...) — see ``match_stats``.
+    Regularized toward identity with weight ``lam`` per cell
+    (IntensityCorrection.solve role). ``smooth_pairs`` adds an intra-view
+    smoothness term tying ADJACENT cells of one view together, which
+    propagates corrections into cells that have no overlap matches (weighted
+    by the mean data moments so it is scale-free).
+    Returns (n_cells, 2) [scale, offset].
+    """
+    # quadratic form: min Σ_m Σ_k (s_a x_k + o_a - s_b y_k - o_b)^2
+    #               + Σ_c lam_c ((s_c-1)^2) + mu_c o_c^2
+    # The data term is HOMOGENEOUS (scaling all maps jointly shrinks it), so
+    # the identity regularizer must be weighted by each cell's own data
+    # moments (lam_c = lam * Σ x², mu_c = lam * Σ n) — scale-free, and the
+    # gauge collapse toward s=0 is resisted in proportion to the data.
+    A = np.zeros((2 * n_cells, 2 * n_cells))
+    rhs = np.zeros(2 * n_cells)
+    cell_xx = np.full(n_cells, 1e-12)
+    cell_n = np.full(n_cells, 1e-12)
+    for ca, cb, n, sx, sy, sxx, syy, sxy in matches:
+        cell_xx[ca] += sxx
+        cell_n[ca] += n
+        cell_xx[cb] += syy
+        cell_n[cb] += n
+    idx = np.arange(n_cells)
+    lam_eff = max(lam, 1e-6)  # unmatched cells must still solve to identity
+    A[2 * idx, 2 * idx] += lam_eff * np.maximum(cell_xx, 1.0)
+    A[2 * idx + 1, 2 * idx + 1] += lam_eff * np.maximum(cell_n, 1.0)
+    rhs[2 * idx] += lam_eff * np.maximum(cell_xx, 1.0)
+    if smooth_pairs:
+        wxx = smooth_weight * max(float(np.mean(cell_xx[cell_xx > 1e-6]))
+                                  if (cell_xx > 1e-6).any() else 1.0, 1.0)
+        wn = smooth_weight * max(float(np.mean(cell_n[cell_n > 1e-6]))
+                                 if (cell_n > 1e-6).any() else 1.0, 1.0)
+        for ci, cj in smooth_pairs:
+            for off, w in ((0, wxx), (1, wn)):
+                i, j = 2 * ci + off, 2 * cj + off
+                A[i, i] += w
+                A[j, j] += w
+                A[i, j] -= w
+                A[j, i] -= w
+    for ca, cb, n, sx, sy, sxx, syy, sxy in matches:
+        ia, ib = 2 * ca, 2 * cb
+        # d/ds_a: Σ x_k (s_a x_k + o_a - s_b y_k - o_b)
+        A[ia, ia] += sxx
+        A[ia, ia + 1] += sx
+        A[ia, ib] -= sxy
+        A[ia, ib + 1] -= sx
+        # d/do_a
+        A[ia + 1, ia] += sx
+        A[ia + 1, ia + 1] += n
+        A[ia + 1, ib] -= sy
+        A[ia + 1, ib + 1] -= n
+        # d/ds_b: -Σ y_k (...)
+        A[ib, ia] -= sxy
+        A[ib, ia + 1] -= sy
+        A[ib, ib] += syy
+        A[ib, ib + 1] += sy
+        # d/do_b
+        A[ib + 1, ia] -= sx
+        A[ib + 1, ia + 1] -= n
+        A[ib + 1, ib] += sy
+        A[ib + 1, ib + 1] += n
+    sol = np.linalg.solve(A, rhs)
+    return sol.reshape(n_cells, 2)
+
+
+def match_stats(x: np.ndarray, y: np.ndarray) -> tuple[float, ...]:
+    """Sufficient statistics (n, Sx, Sy, Sxx, Syy, Sxy) of a sample pair set."""
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    return (float(len(x)), float(x.sum()), float(y.sum()),
+            float((x * x).sum()), float((y * y).sum()), float((x * y).sum()))

@@ -38,14 +38,44 @@ class SyntheticProject:
 
 
 def make_bead_volume(shape, n_beads=150, sigma=1.8, seed=0, background=100.0,
-                     amplitude=3000.0) -> tuple[np.ndarray, np.ndarray]:
-    """Global phantom: Gaussian beads on constant background (float32)."""
+                     amplitude=3000.0, min_separation=8.0,
+                     smooth_field=0.0) -> tuple[np.ndarray, np.ndarray]:
+    """Global phantom: Gaussian beads on constant background (float32).
+
+    Beads keep ``min_separation`` px apart (closer blobs merge under the DoG
+    and break localization-based assertions). ``smooth_field`` > 0 adds a
+    low-frequency random intensity field of that amplitude — dynamic range in
+    every region, which intensity matching needs."""
     rng = np.random.default_rng(seed)
     shape = tuple(int(s) for s in shape)
-    pos = rng.uniform(
-        low=[4, 4, 4], high=[s - 4 for s in shape], size=(n_beads, 3)
-    )
+    pos_list: list[np.ndarray] = []
+    for _ in range(n_beads * 50):
+        if len(pos_list) >= n_beads:
+            break
+        p = rng.uniform(low=[4, 4, 4], high=[s - 4 for s in shape])
+        if pos_list and np.min(
+            np.linalg.norm(np.array(pos_list) - p, axis=1)
+        ) < min_separation:
+            continue
+        pos_list.append(p)
+    pos = np.array(pos_list)
     vol = np.full(shape, background, dtype=np.float32)
+    if smooth_field > 0:
+        coarse = rng.uniform(0, 1, (5, 5, 5)).astype(np.float32)
+        for d, s in enumerate(shape):
+            idx = np.linspace(0, coarse.shape[d] - 1, s)
+            lo = np.floor(idx).astype(int)
+            hi = np.minimum(lo + 1, coarse.shape[d] - 1)
+            f = (idx - lo).astype(np.float32)
+            sl_lo = [slice(None)] * 3
+            sl_hi = [slice(None)] * 3
+            sl_lo[d] = lo
+            sl_hi[d] = hi
+            shape_f = [1, 1, 1]
+            shape_f[d] = s
+            coarse = (coarse[tuple(sl_lo)] * (1 - f.reshape(shape_f))
+                      + coarse[tuple(sl_hi)] * f.reshape(shape_f))
+        vol += smooth_field * coarse
     r = int(np.ceil(3 * sigma))
     ax = np.arange(-r, r + 1, dtype=np.float32)
     gx = np.exp(-(ax ** 2) / (2 * sigma ** 2))
@@ -77,6 +107,7 @@ def make_synthetic_project(
     block_size=(64, 64, 32),
     n_beads_per_tile=40,
     downsampling_factors=((1, 1, 1),),
+    smooth_field=0.0,
 ) -> SyntheticProject:
     """Write ``dataset.xml`` + ``dataset.n5`` under ``out_dir``."""
     rng = np.random.default_rng(seed + 1)
@@ -88,7 +119,8 @@ def make_synthetic_project(
     )
     total_tiles = n_tiles[0] * n_tiles[1] * n_tiles[2]
     vol, beads = make_bead_volume(
-        global_shape, n_beads=n_beads_per_tile * total_tiles, seed=seed
+        global_shape, n_beads=n_beads_per_tile * total_tiles, seed=seed,
+        smooth_field=smooth_field,
     )
 
     os.makedirs(out_dir, exist_ok=True)

@@ -1,0 +1,212 @@
+// blockio: native N5 chunk codec + file IO.
+//
+// Optional fast path for the chunk-store layer (SURVEY.md §2.3: the
+// reference's only native surface is prebuilt codec libs — blosc/zstd/JHDF5;
+// here the equivalent is a small C++ library doing N5 block encode/decode and
+// GIL-free file writes, loaded via ctypes).
+//
+// N5 block format (default mode): big-endian
+//   u16 mode (0 = default), u16 ndim, ndim x u32 block dims,
+//   then the compressed payload; element order is first-axis-fastest
+//   (Fortran w.r.t. the dims), values big-endian.
+//
+// All entry points are C ABI; buffers are caller-allocated. Every function
+// returns a negative value on error. ctypes calls release the GIL, so a
+// Python thread pool driving these runs truly parallel.
+
+#include <zstd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <cerrno>
+
+namespace {
+
+inline void put_u16_be(uint8_t* p, uint16_t v) {
+  p[0] = static_cast<uint8_t>(v >> 8);
+  p[1] = static_cast<uint8_t>(v);
+}
+inline void put_u32_be(uint8_t* p, uint32_t v) {
+  p[0] = static_cast<uint8_t>(v >> 24);
+  p[1] = static_cast<uint8_t>(v >> 16);
+  p[2] = static_cast<uint8_t>(v >> 8);
+  p[3] = static_cast<uint8_t>(v);
+}
+inline uint16_t get_u16_be(const uint8_t* p) {
+  return static_cast<uint16_t>((p[0] << 8) | p[1]);
+}
+inline uint32_t get_u32_be(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 24) | (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | static_cast<uint32_t>(p[3]);
+}
+
+// byte-swap a buffer of n elements of size es (2/4/8) into dst
+void swap_bytes(const uint8_t* src, uint8_t* dst, size_t n, int es) {
+  switch (es) {
+    case 2:
+      for (size_t i = 0; i < n; ++i) {
+        dst[2 * i] = src[2 * i + 1];
+        dst[2 * i + 1] = src[2 * i];
+      }
+      break;
+    case 4:
+      for (size_t i = 0; i < n; ++i) {
+        dst[4 * i] = src[4 * i + 3];
+        dst[4 * i + 1] = src[4 * i + 2];
+        dst[4 * i + 2] = src[4 * i + 1];
+        dst[4 * i + 3] = src[4 * i];
+      }
+      break;
+    case 8:
+      for (size_t i = 0; i < n; ++i)
+        for (int b = 0; b < 8; ++b) dst[8 * i + b] = src[8 * i + 7 - b];
+      break;
+    default:
+      break;
+  }
+}
+
+bool mkdirs_for(const std::string& file_path) {
+  // create every parent directory of file_path
+  size_t pos = 0;
+  while ((pos = file_path.find('/', pos + 1)) != std::string::npos) {
+    std::string dir = file_path.substr(0, pos);
+    if (dir.empty()) continue;
+    if (mkdir(dir.c_str(), 0777) != 0 && errno != EEXIST) return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Max encoded size for a block of raw_bytes payload.
+int64_t n5_encode_bound(int64_t raw_bytes, int32_t ndim) {
+  return 4 + 4 * static_cast<int64_t>(ndim) +
+         static_cast<int64_t>(ZSTD_compressBound(static_cast<size_t>(raw_bytes)));
+}
+
+// Encode one N5 block. data: first-axis-fastest element order, NATIVE
+// (little) endian, n_elem = prod(dims). elem_size in {1,2,4,8}.
+// compression: 0 = raw, 1 = zstd(level). Returns encoded byte count or <0.
+int64_t n5_encode_block(const uint8_t* data, int32_t elem_size,
+                        const uint32_t* dims, int32_t ndim, int64_t n_elem,
+                        int32_t compression, int32_t level, uint8_t* out,
+                        int64_t out_cap) {
+  const int64_t header = 4 + 4 * static_cast<int64_t>(ndim);
+  if (out_cap < header) return -1;
+  put_u16_be(out, 0);
+  put_u16_be(out + 2, static_cast<uint16_t>(ndim));
+  for (int32_t d = 0; d < ndim; ++d) put_u32_be(out + 4 + 4 * d, dims[d]);
+
+  const size_t raw = static_cast<size_t>(n_elem) * elem_size;
+  const uint8_t* payload = data;
+  std::string swapped;
+  if (elem_size > 1) {
+    swapped.resize(raw);
+    swap_bytes(data, reinterpret_cast<uint8_t*>(&swapped[0]),
+               static_cast<size_t>(n_elem), elem_size);
+    payload = reinterpret_cast<const uint8_t*>(swapped.data());
+  }
+  if (compression == 0) {
+    if (out_cap < header + static_cast<int64_t>(raw)) return -1;
+    std::memcpy(out + header, payload, raw);
+    return header + static_cast<int64_t>(raw);
+  }
+  const size_t cap = static_cast<size_t>(out_cap - header);
+  const size_t got = ZSTD_compress(out + header, cap, payload, raw, level);
+  if (ZSTD_isError(got)) return -2;
+  return header + static_cast<int64_t>(got);
+}
+
+// Decode one N5 block into out (native endian, first-axis-fastest).
+// Returns number of elements decoded, or <0. dims_out must hold 16 u32.
+int64_t n5_decode_block(const uint8_t* enc, int64_t enc_len, int32_t elem_size,
+                        int32_t compression, uint8_t* out, int64_t out_cap,
+                        uint32_t* dims_out, int32_t* ndim_out) {
+  if (enc_len < 4) return -1;
+  const uint16_t mode = get_u16_be(enc);
+  if (mode > 1) return -3;  // varlength mode unsupported
+  const int32_t ndim = get_u16_be(enc + 2);
+  if (ndim <= 0 || ndim > 16) return -1;
+  int64_t header = 4 + 4 * static_cast<int64_t>(ndim);
+  if (enc_len < header) return -1;
+  int64_t n_elem = 1;
+  for (int32_t d = 0; d < ndim; ++d) {
+    dims_out[d] = get_u32_be(enc + 4 + 4 * d);
+    n_elem *= dims_out[d];
+  }
+  *ndim_out = ndim;
+  if (mode == 1) header += 4;  // u32 actual element count (varmode)
+  const size_t raw = static_cast<size_t>(n_elem) * elem_size;
+  if (out_cap < static_cast<int64_t>(raw)) return -1;
+
+  std::string tmp;
+  const uint8_t* payload;
+  if (compression == 0) {
+    if (enc_len - header < static_cast<int64_t>(raw)) return -1;
+    payload = enc + header;
+  } else {
+    tmp.resize(raw);
+    const size_t got =
+        ZSTD_decompress(&tmp[0], raw, enc + header,
+                        static_cast<size_t>(enc_len - header));
+    if (ZSTD_isError(got) || got != raw) return -2;
+    payload = reinterpret_cast<const uint8_t*>(tmp.data());
+  }
+  if (elem_size > 1) {
+    swap_bytes(payload, out, static_cast<size_t>(n_elem), elem_size);
+  } else {
+    std::memcpy(out, payload, raw);
+  }
+  return n_elem;
+}
+
+// Encode + write one block file (creates parent dirs). Returns bytes
+// written or <0.
+int64_t n5_write_block_file(const char* path, const uint8_t* data,
+                            int32_t elem_size, const uint32_t* dims,
+                            int32_t ndim, int64_t n_elem, int32_t compression,
+                            int32_t level) {
+  const int64_t cap = n5_encode_bound(n_elem * elem_size, ndim);
+  std::string buf;
+  buf.resize(static_cast<size_t>(cap));
+  const int64_t enc = n5_encode_block(data, elem_size, dims, ndim, n_elem,
+                                      compression, level,
+                                      reinterpret_cast<uint8_t*>(&buf[0]), cap);
+  if (enc < 0) return enc;
+  std::string p(path);
+  if (!mkdirs_for(p)) return -4;
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -5;
+  const size_t wrote = std::fwrite(buf.data(), 1, static_cast<size_t>(enc), f);
+  std::fclose(f);
+  return wrote == static_cast<size_t>(enc) ? enc : -6;
+}
+
+// Read + decode one block file. Returns elements decoded, <0 on error
+// (-7: file missing).
+int64_t n5_read_block_file(const char* path, int32_t elem_size,
+                           int32_t compression, uint8_t* out, int64_t out_cap,
+                           uint32_t* dims_out, int32_t* ndim_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -7;
+  std::fseek(f, 0, SEEK_END);
+  const long len = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf;
+  buf.resize(static_cast<size_t>(len));
+  const size_t got = std::fread(&buf[0], 1, static_cast<size_t>(len), f);
+  std::fclose(f);
+  if (got != static_cast<size_t>(len)) return -6;
+  return n5_decode_block(reinterpret_cast<const uint8_t*>(buf.data()), len,
+                         elem_size, compression, out, out_cap, dims_out,
+                         ndim_out);
+}
+
+}  // extern "C"

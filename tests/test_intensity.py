@@ -1,0 +1,154 @@
+"""Intensity matching + solving: kernel golden tests, pipeline consistency on
+a deliberately miscalibrated synthetic project, and coefficient application
+in the fusion kernel (reference SparkIntensityMatching / IntensitySolver /
+BlkAffineFusion.initWithIntensityCoefficients)."""
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+
+class TestIntensityKernels:
+    def test_linefit_ransac(self):
+        from bigstitcher_spark_tpu.ops.intensity import match_cells_ransac
+
+        rng = np.random.default_rng(0)
+        x = rng.uniform(0, 1, 200).astype(np.float32)
+        y = 0.6 * x + 0.1 + rng.normal(0, 0.004, 200).astype(np.float32)
+        y[:40] = rng.uniform(0, 1, 40)  # 20% outliers
+        fits = match_cells_ransac([x], [y], epsilon=0.02, iterations=500)
+        assert fits[0] is not None
+        a, b, n = fits[0]
+        assert abs(a - 0.6) < 0.05
+        assert abs(b - 0.1) < 0.03
+        assert n > 140
+
+    def test_histogram_match(self):
+        from bigstitcher_spark_tpu.ops.intensity import match_cells_histogram
+
+        rng = np.random.default_rng(1)
+        x = rng.uniform(0.2, 0.8, 500)
+        y = 1.5 * x - 0.1
+        fits = match_cells_histogram([x], [rng.permutation(y)])
+        a, b, _ = fits[0]
+        assert abs(a - 1.5) < 0.05
+        assert abs(b + 0.1) < 0.05
+
+    def test_solve_consistency(self):
+        from bigstitcher_spark_tpu.ops.intensity import (
+            match_stats, solve_intensity_coefficients,
+        )
+
+        rng = np.random.default_rng(2)
+        x = rng.uniform(10, 100, 500)
+        y = 0.5 * x - 5.0  # cell 1 reads half as bright
+        sol = solve_intensity_coefficients(
+            2, [(0, 1, *match_stats(x, y))], lam=1e-4,
+        )
+        # corrected values must agree: s0*x + o0 == s1*y + o1
+        lhs = sol[0, 0] * x + sol[0, 1]
+        rhs = sol[1, 0] * y + sol[1, 1]
+        np.testing.assert_allclose(lhs, rhs, atol=0.5)
+        # regularization keeps the mean map near identity (gauge fixing)
+        assert 0.5 < sol[:, 0].mean() < 1.5
+
+
+class TestIntensityPipeline:
+    @pytest.fixture(scope="class")
+    def project(self, tmp_path_factory):
+        """2-tile project where tile 1's stored data is rescaled
+        (i -> 1.4*i + 30): the miscalibration the tools must recover."""
+        from bigstitcher_spark_tpu.io.chunkstore import ChunkStore
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+        import os
+
+        proj = make_synthetic_project(
+            str(tmp_path_factory.mktemp("intensity") / "proj"),
+            n_tiles=(2, 1, 1), tile_size=(96, 96, 48), overlap=40,
+            jitter=0.0, seed=21, n_beads_per_tile=30,
+            smooth_field=600.0,  # dynamic range everywhere: line fits need it
+        )
+        store = ChunkStore.open(
+            os.path.join(os.path.dirname(proj.xml_path), "dataset.n5"))
+        ds = store.open_dataset("setup1/timepoint0/s0")
+        img = ds.read_full().astype(np.float64)
+        ds.write(np.clip(1.4 * img + 30, 0, 65535).astype(np.uint16), (0, 0, 0))
+        return proj
+
+    def test_match_solve_consistency(self, project):
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.spimdata import SpimData, ViewId
+        from bigstitcher_spark_tpu.models.intensity import (
+            IntensityParams, match_intensities, solve_intensities,
+        )
+
+        sd = SpimData.load(project.xml_path)
+        loader = ViewLoader(sd)
+        views = sorted(sd.registrations)
+        params = IntensityParams(coefficients=(2, 2, 2), render_scale=0.5)
+        matches = match_intensities(sd, loader, views, params, progress=False)
+        assert len(matches) > 0
+        coeffs = solve_intensities(matches, views, params.coefficients,
+                                   lam=0.01, progress=False)
+        # the fitted pairwise relation y ~= a*x+b must be equalized:
+        # f0(x) ~= f1(1.4x + 30) for typical intensities
+        c0 = coeffs[ViewId(0, 0)].reshape(-1, 2).mean(axis=0)
+        c1 = coeffs[ViewId(0, 1)].reshape(-1, 2).mean(axis=0)
+        for i in (100.0, 500.0, 2000.0):
+            lhs = c0[0] * i + c0[1]
+            rhs = c1[0] * (1.4 * i + 30.0) + c1[1]
+            assert abs(lhs - rhs) / max(lhs, 1.0) < 0.12, (i, lhs, rhs)
+
+    def test_cli_and_corrected_fusion(self, project, tmp_path):
+        """CLI round trip + fused output: with correction, the two sides of
+        the overlap seam must agree much better than without."""
+        from bigstitcher_spark_tpu.cli.main import cli
+        from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.spimdata import SpimData, ViewId
+        from bigstitcher_spark_tpu.models.affine_fusion import fuse_volume
+        from bigstitcher_spark_tpu.models.intensity import IntensityStore
+        from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+        runner = CliRunner()
+        res = runner.invoke(cli, [
+            "match-intensities", "-x", project.xml_path,
+            "--coefficients", "2,2,2", "--renderScale", "0.5",
+        ])
+        assert res.exit_code == 0, res.output
+        res = runner.invoke(cli, [
+            "solve-intensities", "-x", project.xml_path, "--lambda", "0.01",
+        ])
+        assert res.exit_code == 0, res.output
+
+        sd = SpimData.load(project.xml_path)
+        loader = ViewLoader(sd)
+        views = sorted(sd.registrations)
+        istore = IntensityStore.for_project(sd)
+        coeffs = {v: istore.load_coefficients(v).astype(np.float32)
+                  for v in views}
+        assert all(c is not None for c in coeffs.values())
+
+        bbox = maximal_bounding_box(sd, views, None)
+        outs = {}
+        for name, cf in (("raw", None), ("corrected", coeffs)):
+            cstore = ChunkStore.create(str(tmp_path / f"{name}.n5"),
+                                       StorageFormat.N5)
+            ds = cstore.create_dataset("f", bbox.shape, (64, 64, 48), "float32")
+            fuse_volume(sd, loader, views, ds, bbox, block_size=(64, 64, 48),
+                        block_scale=(1, 1, 1), fusion_type="FIRST_WINS",
+                        out_dtype="float32", min_intensity=0.0,
+                        max_intensity=1.0, coefficients=cf)
+            outs[name] = ds.read_full()
+
+        # seam: columns just left/right of the boundary between the region
+        # covered by view 0 (FIRST_WINS) and view 1 only
+        x_seam = 96 - bbox.min[0]  # view 0 ends here in output coords
+        left = {k: v[x_seam - 3:x_seam, 8:88, 8:40].mean()
+                for k, v in outs.items()}
+        right = {k: v[x_seam + 1:x_seam + 4, 8:88, 8:40].mean()
+                 for k, v in outs.items()}
+        jump_raw = abs(left["raw"] - right["raw"]) / right["raw"]
+        jump_cor = abs(left["corrected"] - right["corrected"]) / right["corrected"]
+        assert jump_raw > 0.15          # the miscalibration is visible
+        assert jump_cor < jump_raw / 3  # correction removes most of it

@@ -1,0 +1,76 @@
+"""Native N5 block codec (native/blockio.cpp via ctypes): round trips and
+bidirectional interop with the tensorstore N5 driver — the independent-decoder
+check that guards the on-disk contract."""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigstitcher_spark_tpu.io import native_blockio
+
+pytestmark = pytest.mark.skipif(
+    not native_blockio.available(), reason="native blockio not built"
+)
+
+
+def test_roundtrip_dtypes(tmp_path):
+    rng = np.random.default_rng(0)
+    for dtype, gen in (
+        ("uint8", lambda s: rng.integers(0, 255, s).astype(np.uint8)),
+        ("uint16", lambda s: rng.integers(0, 65535, s).astype(np.uint16)),
+        ("float32", lambda s: rng.normal(size=s).astype(np.float32)),
+        ("float64", lambda s: rng.normal(size=s)),
+    ):
+        for comp in ("zstd", "raw"):
+            data = gen((17, 9, 5))
+            p = str(tmp_path / f"{dtype}_{comp}" / "0" / "0" / "0")
+            native_blockio.write_block(p, data, compression=comp)
+            back = native_blockio.read_block(p, dtype, (17, 9, 5),
+                                             compression=comp)
+            np.testing.assert_array_equal(back, data)
+
+
+def test_missing_block_returns_none(tmp_path):
+    assert native_blockio.read_block(
+        str(tmp_path / "nope"), np.uint16, (4, 4, 4)) is None
+
+
+def test_interop_with_tensorstore(tmp_path):
+    from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+
+    rng = np.random.default_rng(1)
+    store = ChunkStore.create(str(tmp_path / "t.n5"), StorageFormat.N5)
+    ds = store.create_dataset("ds", (40, 30, 20), (16, 16, 16), "uint16")
+    data = rng.integers(0, 65535, (40, 30, 20)).astype(np.uint16)
+
+    # native writes (through Dataset.write fast path) -> tensorstore reads
+    for ox in range(0, 40, 16):
+        for oy in range(0, 30, 16):
+            for oz in range(0, 20, 16):
+                ds.write(data[ox:ox + 16, oy:oy + 16, oz:oz + 16],
+                         (ox, oy, oz))
+    np.testing.assert_array_equal(store.open_dataset("ds").read_full(), data)
+
+    # tensorstore writes -> native reads
+    os.environ["BST_NATIVE_IO"] = "0"
+    try:
+        ds2 = store.create_dataset("ds2", (16, 16, 16), (16, 16, 16), "uint16")
+        ds2.write(data[:16, :16, :16], (0, 0, 0))
+    finally:
+        os.environ["BST_NATIVE_IO"] = "1"
+    back = native_blockio.read_block(
+        str(tmp_path / "t.n5" / "ds2" / "0" / "0" / "0"), np.uint16,
+        (16, 16, 16))
+    np.testing.assert_array_equal(back, data[:16, :16, :16])
+
+
+def test_unaligned_write_falls_back(tmp_path):
+    """Non-block-aligned writes must still work (tensorstore path)."""
+    from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+
+    store = ChunkStore.create(str(tmp_path / "t.n5"), StorageFormat.N5)
+    ds = store.create_dataset("ds", (32, 32, 32), (16, 16, 16), "uint16")
+    data = np.arange(8 * 8 * 8, dtype=np.uint16).reshape(8, 8, 8)
+    ds.write(data, (4, 4, 4))
+    np.testing.assert_array_equal(ds.read((4, 4, 4), (8, 8, 8)), data)

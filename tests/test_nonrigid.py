@@ -1,0 +1,193 @@
+"""Non-rigid fusion: control-grid fit golden tests, kernel vs affine parity
+under identity deformation, and a misregistration-recovery pipeline test (the
+capability SparkNonRigidFusion exists for: residual deformation after affine
+registration is absorbed by the interest-point-driven warp)."""
+
+import numpy as np
+import pytest
+
+
+class TestControlGrid:
+    def test_reproduces_global_affine(self):
+        from bigstitcher_spark_tpu.ops.nonrigid import fit_control_grid
+
+        rng = np.random.default_rng(0)
+        A = np.array([[1.02, 0.03, 0.0, 5.0],
+                      [-0.02, 0.99, 0.01, -3.0],
+                      [0.0, 0.01, 1.01, 2.0]])
+        targets = rng.uniform(0, 100, (60, 3))
+        vw = targets @ A[:, :3].T + A[:, 3]
+        grid = fit_control_grid(targets, vw, np.zeros(3), (5, 5, 5), 25.0)
+        # every vertex model must equal the global affine
+        models = grid.reshape(-1, 3, 4)
+        np.testing.assert_allclose(models, np.broadcast_to(A, models.shape),
+                                   atol=1e-3)
+
+    def test_local_deformation(self):
+        """Vertices near a locally-shifted cluster adopt that shift; far
+        vertices keep the other cluster's (IDW falls off with distance)."""
+        from bigstitcher_spark_tpu.ops.nonrigid import fit_control_grid
+
+        rng = np.random.default_rng(1)
+        t_lo = rng.uniform(2, 28, (40, 3))
+        t_hi = rng.uniform(72, 98, (40, 3))
+        targets = np.concatenate([t_lo, t_hi])
+        shift = np.zeros((80, 3))
+        shift[40:, 0] = 4.0  # the far cluster is shifted +4 in x
+        vw = targets + shift
+        grid = fit_control_grid(targets, vw, np.zeros(3), (11, 11, 11), 10.0)
+        # vertex (1,1,1)=10px: near low cluster -> deformation there ~0
+        m = grid[1, 1, 1].reshape(3, 4)
+        pred = m[:, :3] @ np.array([10.0, 10, 10]) + m[:, 3]
+        assert abs(pred[0] - 10.0) < 0.6
+        # vertex (9,9,9)=90px: near high cluster -> shift ~4 in x
+        m = grid[9, 9, 9].reshape(3, 4)
+        pred = m[:, :3] @ np.array([90.0, 90, 90]) + m[:, 3]
+        assert abs(pred[0] - 94.0) < 0.6
+
+    def test_few_points_fallback(self):
+        from bigstitcher_spark_tpu.ops.nonrigid import fit_control_grid
+
+        grid = fit_control_grid(
+            np.array([[10.0, 10, 10], [20.0, 20, 20]]),
+            np.array([[12.0, 10, 10], [22.0, 20, 20]]),
+            np.zeros(3), (3, 3, 3), 10.0,
+        )
+        m = grid[0, 0, 0].reshape(3, 4)
+        np.testing.assert_allclose(m[:, :3], np.eye(3))
+        np.testing.assert_allclose(m[:, 3], [2.0, 0, 0])
+
+
+class TestNonrigidKernel:
+    def test_identity_grid_matches_direct_sampling(self):
+        from bigstitcher_spark_tpu.ops.nonrigid import nonrigid_fuse_block
+
+        rng = np.random.default_rng(2)
+        patch = rng.uniform(0, 1000, (40, 40, 40)).astype(np.float32)
+        gdims = (5, 5, 5)
+        grids = np.zeros((1, *gdims, 12), np.float32)
+        grids[..., 0] = grids[..., 5] = grids[..., 10] = 1.0
+        ident = np.hstack([np.eye(3), np.zeros((3, 1))]).astype(np.float32)
+        fused, wsum = nonrigid_fuse_block(
+            patch[None], grids, ident[None], np.zeros((1, 3), np.float32),
+            np.full((1, 3), 40.0, np.float32), np.zeros((1, 3), np.float32),
+            np.full((1, 3), 1e-6, np.float32), np.ones(1, np.float32),
+            np.zeros(3, np.float32), np.zeros(3, np.float32),
+            np.full(3, 10.0, np.float32),
+            block_shape=(32, 32, 32), fusion_type="AVG",
+        )
+        # fp rounding in the coefficient interpolation perturbs sampling
+        # coordinates by ~1e-6 px; with O(1e3) local gradients that is ~1e-3
+        # absolute — not bit-exactness (SURVEY §7 float-determinism note)
+        np.testing.assert_allclose(np.asarray(fused), patch[:32, :32, :32],
+                                   atol=0.5)
+
+    def test_constant_translation_grid_shifts_sampling(self):
+        from bigstitcher_spark_tpu.ops.nonrigid import nonrigid_fuse_block
+
+        rng = np.random.default_rng(3)
+        patch = rng.uniform(0, 1000, (40, 40, 40)).astype(np.float32)
+        gdims = (5, 5, 5)
+        grids = np.zeros((1, *gdims, 12), np.float32)
+        grids[..., 0] = grids[..., 5] = grids[..., 10] = 1.0
+        grids[..., 3] = 3.0  # world -> view-world: +3 in x
+        ident = np.hstack([np.eye(3), np.zeros((3, 1))]).astype(np.float32)
+        fused, _ = nonrigid_fuse_block(
+            patch[None], grids, ident[None], np.zeros((1, 3), np.float32),
+            np.full((1, 3), 40.0, np.float32), np.zeros((1, 3), np.float32),
+            np.full((1, 3), 1e-6, np.float32), np.ones(1, np.float32),
+            np.zeros(3, np.float32), np.zeros(3, np.float32),
+            np.full(3, 10.0, np.float32),
+            block_shape=(32, 32, 32), fusion_type="AVG",
+        )
+        np.testing.assert_allclose(np.asarray(fused), patch[3:35, :32, :32],
+                                   atol=0.5)
+
+
+class TestNonrigidPipeline:
+    def test_recovers_misregistration(self, tmp_path):
+        """Tiles registered at their (wrong) nominal offsets: affine fusion
+        double-images beads in the overlap; non-rigid fusion driven by
+        matched interest points must re-align them (bead residual < 1 px)."""
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.interestpoints import InterestPointStore
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models.detection import (
+            DetectionParams, detect_interest_points, save_detections,
+        )
+        from bigstitcher_spark_tpu.models.matching import (
+            MatchingParams, match_interest_points, save_matches,
+        )
+        from bigstitcher_spark_tpu.models.nonrigid_fusion import (
+            build_unique_points, fuse_nonrigid_volume,
+        )
+        from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+        from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+        from bigstitcher_spark_tpu.ops.dog import dog_block, localize_quadratic
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(2, 1, 1), tile_size=(96, 96, 48),
+            overlap=40, jitter=3.0, seed=13, n_beads_per_tile=40,
+        )
+        sd = SpimData.load(proj.xml_path)
+        views = sorted(sd.registrations)
+        loader = ViewLoader(sd)
+        dets = detect_interest_points(
+            sd, loader, views,
+            DetectionParams(downsample_xy=1, downsample_z=1,
+                            block_size=(96, 96, 48)),
+            progress=False,
+        )
+        store = InterestPointStore(str(tmp_path / "proj" / "interestpoints.n5"))
+        dparams = DetectionParams()
+        save_detections(sd, store, dets, dparams)
+        mparams = MatchingParams(ransac_min_inliers=5, ransac_iterations=2000,
+                                 model="TRANSLATION", regularization="NONE")
+        res = match_interest_points(sd, views, mparams, store, progress=False)
+        save_matches(sd, store, res, mparams, views)
+
+        unique = build_unique_points(sd, store, views, ["beads"])
+        assert all(len(unique.targets[v]) > 0 for v in views)
+
+        bbox = maximal_bounding_box(sd, views, None)
+        cstore = ChunkStore.create(str(tmp_path / "fused.n5"), StorageFormat.N5)
+        out = cstore.create_dataset("fused", bbox.shape, (64, 64, 48), "float32")
+        stats = fuse_nonrigid_volume(
+            sd, loader, views, unique, out, bbox,
+            block_size=(64, 64, 48), block_scale=(1, 1, 1), cpd=10.0,
+            out_dtype="float32", min_intensity=0.0, max_intensity=1.0,
+        )
+        assert stats.voxels == bbox.num_elements
+        vol = out.read_full()
+
+        # detect beads in the fused volume; each true bead inside the fused
+        # bbox must appear exactly once within <1px of SOME detection whose
+        # position matches the correspondence-averaged truth
+        dogv, mask = dog_block(vol, np.float32(vol.min()),
+                               np.float32(vol.max()), np.float32(0.01), 1.8)
+        coords = np.argwhere(np.asarray(mask))
+        subs, _ = localize_quadratic(np.asarray(dogv), coords)
+        fused_pts = subs + np.array(bbox.min)
+
+        # the warp aligns each correspondence at the AVERAGE of the views'
+        # (jittered) world positions: expected = bead + mean registration error
+        drift = 0.5 * ((proj.nominal_offsets[0] - proj.true_offsets[0])
+                       + (proj.nominal_offsets[1] - proj.true_offsets[1]))
+        checked = 0
+        for bead in proj.bead_positions:
+            # consider beads well inside the overlap region of both tiles
+            in0 = np.all((bead - proj.true_offsets[0] >= 8)
+                         & (bead - proj.true_offsets[0] <= [88, 88, 40]))
+            in1 = np.all((bead - proj.true_offsets[1] >= 8)
+                         & (bead - proj.true_offsets[1] <= [88, 88, 40]))
+            if not (in0 and in1):
+                continue
+            expect = bead + drift
+            d = np.linalg.norm(fused_pts - expect, axis=1)
+            near = np.sort(d)[:2]
+            assert near[0] < 1.5, f"bead {bead} unmatched (nearest {near[0]:.2f})"
+            # no double image: second detection must be a DIFFERENT bead, far
+            assert near[1] > 4.0, f"bead {bead} double-imaged ({near})"
+            checked += 1
+        assert checked >= 3

@@ -1,0 +1,188 @@
+"""Utility tools: clear-interestpoints, clear-registrations, transform-points,
+split-images (reference ClearInterestPoints / ClearRegistrations /
+TransformPoints / SplitDatasets semantics)."""
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+
+@pytest.fixture()
+def project(tmp_path):
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    return make_synthetic_project(
+        str(tmp_path / "proj"), n_tiles=(2, 1, 1), tile_size=(96, 96, 48),
+        overlap=24, jitter=2.0, seed=3, n_beads_per_tile=20,
+    )
+
+
+def test_clear_registrations_remove_and_keep(project):
+    from bigstitcher_spark_tpu.cli.main import cli
+    from bigstitcher_spark_tpu.io.spimdata import SpimData, ViewId
+
+    sd = SpimData.load(project.xml_path)
+    assert len(sd.registrations[ViewId(0, 0)]) == 2
+    runner = CliRunner()
+    # --remove 1 drops the LAST-applied (list head: the grid translation)
+    res = runner.invoke(cli, ["clear-registrations", "-x", project.xml_path,
+                              "--remove", "1"])
+    assert res.exit_code == 0, res.output
+    sd = SpimData.load(project.xml_path)
+    chain = sd.registrations[ViewId(0, 0)]
+    assert len(chain) == 1
+    assert chain[0].name == "calibration"
+    # --keep 0 empties the chain
+    res = runner.invoke(cli, ["clear-registrations", "-x", project.xml_path,
+                              "--keep", "0"])
+    assert res.exit_code == 0, res.output
+    sd = SpimData.load(project.xml_path)
+    assert sd.registrations[ViewId(0, 0)] == []
+    # exactly one of keep/remove required
+    assert runner.invoke(cli, ["clear-registrations", "-x", project.xml_path]
+                         ).exit_code != 0
+
+
+def test_clear_interestpoints(project):
+    from bigstitcher_spark_tpu.cli.main import cli
+    from bigstitcher_spark_tpu.io.interestpoints import (
+        CorrespondingPoint, InterestPointStore,
+    )
+    from bigstitcher_spark_tpu.io.spimdata import SpimData, ViewId
+
+    sd = SpimData.load(project.xml_path)
+    store = InterestPointStore.for_project(sd)
+    v0, v1 = ViewId(0, 0), ViewId(0, 1)
+    from bigstitcher_spark_tpu.io.interestpoints import register_points_in_xml
+
+    for v in (v0, v1):
+        grp = store.save_points(v, "beads", np.random.rand(10, 3) * 50)
+        register_points_in_xml(sd, v, "beads", "test", grp)
+    store.save_correspondences(v0, "beads",
+                               [CorrespondingPoint(0, v1, "beads", 1)])
+    sd.save(project.xml_path)
+
+    runner = CliRunner()
+    res = runner.invoke(cli, ["clear-interestpoints", "-x", project.xml_path,
+                              "--onlyCorrespondences"])
+    assert res.exit_code == 0, res.output
+    assert store.load_correspondences(v0, "beads") == []
+    ids, _ = store.load_points(v0, "beads")
+    assert len(ids) == 10  # points kept
+
+    res = runner.invoke(cli, ["clear-interestpoints", "-x", project.xml_path])
+    assert res.exit_code == 0, res.output
+    sd = SpimData.load(project.xml_path)
+    assert v0 not in sd.interest_points
+    ids, _ = store.load_points(v0, "beads")
+    assert len(ids) == 0
+
+
+def test_transform_points(project, tmp_path):
+    from bigstitcher_spark_tpu.cli.main import cli
+    from bigstitcher_spark_tpu.io.spimdata import SpimData, ViewId
+    from bigstitcher_spark_tpu.utils.geometry import apply_affine
+
+    sd = SpimData.load(project.xml_path)
+    expect = apply_affine(sd.model(ViewId(0, 1)), np.array([[10.0, 20.0, 5.0]]))
+    runner = CliRunner()
+    res = runner.invoke(cli, ["transform-points", "-x", project.xml_path,
+                              "-vi", "0,1", "-p", "10,20,5"])
+    assert res.exit_code == 0, res.output
+    got = [float(v) for v in res.output.strip().split("-> ")[1].split(",")]
+    np.testing.assert_allclose(got, expect[0], atol=1e-9)
+
+    csv_in = tmp_path / "pts.csv"
+    csv_in.write_text("10,20,5\n1,2,3\n")
+    csv_out = tmp_path / "out.csv"
+    res = runner.invoke(cli, ["transform-points", "-x", project.xml_path,
+                              "-vi", "0,1", "--csvIn", str(csv_in),
+                              "--csvOut", str(csv_out)])
+    assert res.exit_code == 0, res.output
+    rows = [[float(v) for v in line.split(",")]
+            for line in csv_out.read_text().strip().splitlines()]
+    np.testing.assert_allclose(rows[0], expect[0], atol=1e-9)
+
+
+class TestSplitImages:
+    def test_split_geometry_and_reads(self, project, tmp_path):
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.spimdata import SpimData, ViewId
+        from bigstitcher_spark_tpu.models.splitting import split_images
+        from bigstitcher_spark_tpu.utils.geometry import apply_affine
+
+        sd = SpimData.load(project.xml_path)
+        loader = ViewLoader(sd)
+        new_sd = split_images(sd, loader, (64, 64, 48), (16, 16, 8))
+        # 96x96 tile with 64-size/16-overlap: starts [0,32] per xy axis -> 4 subtiles
+        assert len(new_sd.setups) == 2 * 4
+        out_xml = str(tmp_path / "split.xml")
+        new_sd.save(out_xml)
+        rt = SpimData.load(out_xml)
+        assert rt.split_info == new_sd.split_info
+
+        # data: sub-view read must equal the source crop
+        new_loader = ViewLoader(rt)
+        src_img = loader.open(ViewId(0, 0)).read_full()
+        for setup, (src, off) in sorted(rt.split_info.items())[:4]:
+            if src != 0:
+                continue
+            sub = new_loader.open(ViewId(0, setup)).read_full()
+            sl = tuple(slice(o, o + s) for o, s in zip(off, sub.shape))
+            np.testing.assert_array_equal(sub, src_img[sl])
+            # geometry: sub-view pixel p maps to the same world point as
+            # source pixel p+off
+            w_sub = apply_affine(rt.model(ViewId(0, setup)),
+                                 np.array([[1.0, 2.0, 3.0]]))
+            w_src = apply_affine(sd.model(ViewId(0, 0)),
+                                 np.array([[1.0 + off[0], 2.0 + off[1],
+                                            3.0 + off[2]]]))
+            np.testing.assert_allclose(w_sub, w_src, atol=1e-9)
+
+    def test_fake_interest_points_glue(self, project, tmp_path):
+        """Fake points must give the solver exact links: solving the split
+        project with jittered sub-tile positions must snap them back."""
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.interestpoints import InterestPointStore
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models.splitting import split_images
+
+        sd = SpimData.load(project.xml_path)
+        loader = ViewLoader(sd)
+        store = InterestPointStore(str(tmp_path / "ip.n5"))
+        new_sd = split_images(
+            sd, loader, (64, 64, 48), (16, 16, 8),
+            fake_interest_points=True, fip_error=0.0, fip_store=store,
+        )
+        views = sorted(new_sd.registrations)
+        with_ips = [v for v in views if "splitPoints" in
+                    new_sd.interest_points.get(v, {})]
+        assert len(with_ips) == len(views)
+        # correspondences are symmetric and world-consistent
+        c0 = store.load_correspondences(with_ips[0], "splitPoints")
+        assert len(c0) > 0
+        from bigstitcher_spark_tpu.utils.geometry import apply_affine
+
+        ids, locs = store.load_points(with_ips[0], "splitPoints")
+        lut = dict(zip(ids.astype(int), locs))
+        for c in c0[:20]:
+            oids, olocs = store.load_points(c.other_view, c.other_label)
+            olut = dict(zip(oids.astype(int), olocs))
+            wa = apply_affine(new_sd.model(with_ips[0]), lut[c.id])
+            wb = apply_affine(new_sd.model(c.other_view), olut[c.other_id])
+            np.testing.assert_allclose(wa, wb, atol=1e-6)
+
+
+def test_cli_split(project, tmp_path):
+    from bigstitcher_spark_tpu.cli.main import cli
+    from bigstitcher_spark_tpu.io.spimdata import SpimData
+
+    runner = CliRunner()
+    out_xml = str(tmp_path / "split.xml")
+    res = runner.invoke(cli, ["split-images", "-x", project.xml_path,
+                              "--xmlout", out_xml,
+                              "-s", "64,64,48", "-o", "16,16,8"])
+    assert res.exit_code == 0, res.output
+    sd = SpimData.load(out_xml)
+    assert len(sd.setups) == 8
+    assert len(sd.split_info) == 8
