@@ -6,22 +6,37 @@ uint16, AVG_BLEND) into an OME-ZARR container on the available accelerator
 and reports fused output voxels per second for the steady-state (warm
 compile-cache) run.
 
-vs_baseline: the reference publishes no numbers (BASELINE.json.published={}),
-so the comparison point is the documented estimate of BigStitcher-Spark on
-Spark local[8] CPU for this workload: ~2e7 fused voxels/sec (order of
-magnitude from the reference's own stage self-timing hooks; BASELINE.md §
-"Metrics"). vs_baseline = measured / 2e7, i.e. the ≥4x north-star target is
-vs_baseline >= 4.
+Robustness: the TPU backend arrives through a one-client tunnel that can be
+busy or flaky, so the measurement runs in a CHILD process with a hard
+timeout and bounded retries; if the accelerator can't be initialized the
+bench falls back to a CPU run (reported with "platform": "cpu") rather than
+producing no number at all (the round-1 failure mode).
+
+vs_baseline: measured against a REAL measurement of a reference-equivalent
+CPU implementation — plain numpy + scipy.ndimage trilinear affine fusion
+over the same block grid, 8 host threads (the analogue of the reference's
+Spark local[8] deployment, BASELINE.md) — on this same fixture, on this
+machine. The measurement is cached with provenance in BASELINE_MEASURED.json
+and validated against the XLA output before timing.
 """
 
 import json
 import os
 import shutil
+import subprocess
 import sys
 import time
 
-BASELINE_VOX_PER_SEC = 2.0e7
 FIXTURE = os.environ.get("BST_BENCH_DIR", "/tmp/bst_bench")
+REPO = os.path.dirname(os.path.abspath(__file__))
+BASELINE_FILE = os.path.join(REPO, "BASELINE_MEASURED.json")
+FIXTURE_SPEC = {
+    "n_tiles": (2, 2, 1), "tile_size": (256, 256, 128), "overlap": 32,
+    "jitter": 0.0, "seed": 11, "block_size": (128, 128, 64),
+    "n_beads_per_tile": 120,
+}
+CHILD_TIMEOUT_S = int(os.environ.get("BST_BENCH_CHILD_TIMEOUT", 1500))
+TPU_ATTEMPTS = 2
 
 
 def build_fixture():
@@ -31,12 +46,7 @@ def build_fixture():
     if os.path.exists(marker):
         return marker
     shutil.rmtree(FIXTURE, ignore_errors=True)
-    make_synthetic_project(
-        os.path.join(FIXTURE, "proj"),
-        n_tiles=(2, 2, 1), tile_size=(256, 256, 128), overlap=32,
-        jitter=0.0, seed=11, block_size=(128, 128, 64),
-        n_beads_per_tile=120,
-    )
+    make_synthetic_project(os.path.join(FIXTURE, "proj"), **FIXTURE_SPEC)
     return marker
 
 
@@ -66,23 +76,270 @@ def run_fusion(xml_path, out_path, block_scale=(2, 2, 1)):
         out_dtype="uint16", min_intensity=0.0, max_intensity=65535.0,
         zarr_ct=(0, 0),
     )
-    return stats
+    return stats, ds, bbox
 
 
-def main():
+# ---------------------------------------------------------------------------
+# Reference-equivalent CPU baseline (numpy + scipy, 8 threads = "local[8]")
+# ---------------------------------------------------------------------------
+
+
+def _baseline_fuse_block(sd, loader, views, block_global, blend_range=40.0):
+    """One output block fused exactly the way the reference's BlkAffineFusion
+    does it, in plain host code: per view, inverse-affine coordinates,
+    trilinear sample (scipy.ndimage.map_coordinates order=1), cosine-edge
+    blend weight, weighted average (AVG_BLEND)."""
+    import numpy as np
+    from scipy.ndimage import map_coordinates
+
+    from bigstitcher_spark_tpu.utils.geometry import (
+        Interval, invert_affine, transformed_interval,
+    )
+
+    shape = block_global.shape
+    acc = np.zeros(shape, np.float32)
+    wsum = np.zeros(shape, np.float32)
+    # world coords of block voxels, per axis broadcastable (X,1,1)/(1,Y,1)/(1,1,Z)
+    axes = [
+        (np.arange(shape[d], dtype=np.float32) + block_global.min[d]).reshape(
+            [-1 if i == d else 1 for i in range(3)])
+        for d in range(3)
+    ]
+    for v in views:
+        inv = invert_affine(sd.model(v)).astype(np.float32)
+        img_dim = np.asarray(sd.view_size(v), np.float32)
+        src = transformed_interval(inv, block_global).expand(1)
+        img_iv = Interval.from_shape(sd.view_size(v))
+        if not src.overlaps(img_iv):
+            continue
+        clipped = src.intersect(img_iv)
+        if clipped.is_empty():
+            continue
+        patch = loader.read_block(v, 0, tuple(clipped.min), clipped.shape
+                                  ).astype(np.float32)
+        w = None
+        coords = []
+        for i in range(3):
+            li = (inv[i, 0] * axes[0] + inv[i, 1] * axes[1]
+                  + inv[i, 2] * axes[2] + inv[i, 3])  # (X,Y,Z) level coords
+            coords.append(li - np.float32(clipped.min[i]))
+            # cosine edge ramp + inside mask along this level axis
+            d = np.minimum(li, (img_dim[i] - 1.0) - li)
+            ramp = 0.5 * (np.cos((1.0 - d / np.float32(blend_range)) * np.pi)
+                          + 1.0)
+            wi = np.where(d < 0, np.float32(0),
+                          np.where(d < blend_range, ramp, np.float32(1)))
+            w = wi if w is None else w * wi
+        val = map_coordinates(patch, coords, order=1, mode="constant",
+                              cval=0.0, output=np.float32)
+        acc += val * w
+        wsum += w
+    fused = np.where(wsum > 0, acc / np.maximum(wsum, np.float32(1e-20)), 0.0)
+    # uint16 convert at min=0, max=65535 (identity scale)
+    return np.clip(np.round(fused), 0, 65535).astype("uint16")
+
+
+def measure_baseline(xml_path, threads=None):
+    """Measure the reference-equivalent CPU fusion on the bench fixture.
+
+    Returns voxels/sec. The result is cached in BASELINE_MEASURED.json keyed
+    by the fixture spec so the (slow) measurement runs once per machine.
+    ``threads`` defaults to min(8, cpu_count) — the reference's local[8]
+    deployment collapses to the actual core count on small hosts (measured:
+    on a 1-core host 8 threads THRASH numpy to 4x slower, so claiming
+    local[8] concurrency there would strawman the baseline)."""
+    if threads is None:
+        threads = max(1, min(8, os.cpu_count() or 1))
+    import hashlib
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    key = hashlib.sha256(
+        json.dumps({"spec": FIXTURE_SPEC, "threads": threads},
+                   sort_keys=True, default=str).encode()).hexdigest()[:16]
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            cached = json.load(f)
+        if cached.get("key") == key and cached.get("vox_per_sec", 0) > 0:
+            return float(cached["vox_per_sec"])
+
+    from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+    from bigstitcher_spark_tpu.io.spimdata import SpimData
+    from bigstitcher_spark_tpu.utils.geometry import Interval
+    from bigstitcher_spark_tpu.utils.grid import create_grid
+    from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+    sd = SpimData.load(xml_path)
+    loader = ViewLoader(sd)
+    views = sd.view_ids()
+    bbox = maximal_bounding_box(sd, views)
+    compute_block = (128, 128, 64)
+    grid = create_grid(bbox.shape, compute_block, (128, 128, 64))
+
+    def do_block(block):
+        bg = Interval.from_shape(block.size, block.offset).translate(bbox.min)
+        return _baseline_fuse_block(sd, loader, views, bg)
+
+    # warm the OS page cache so IO parity matches the measured run
+    do_block(grid[0])
+    t0 = time.time()
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        outs = list(pool.map(do_block, grid))
+    dt = time.time() - t0
+    vox = int(np.prod(bbox.shape))
+    vox_per_sec = vox / dt
+    with open(BASELINE_FILE, "w") as f:
+        json.dump({
+            "key": key,
+            "vox_per_sec": round(vox_per_sec, 1),
+            "voxels": vox,
+            "seconds": round(dt, 3),
+            "threads": threads,
+            "method": (
+                "reference-equivalent CPU affine fusion: numpy + "
+                "scipy.ndimage.map_coordinates trilinear resample, cosine-edge "
+                "AVG_BLEND weights, uint16 convert, over the reference's "
+                "(128,128,64) block grid; ThreadPoolExecutor(min(8, cores)) "
+                "approximates the reference's Spark local[8] deployment "
+                "(BASELINE.md) at this host's actual core count. Measured on "
+                "this machine, same fixture as the bench."
+            ),
+            "fixture": {k: list(v) if isinstance(v, tuple) else v
+                        for k, v in FIXTURE_SPEC.items()},
+            "cpu_count": os.cpu_count(),
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "checksum_block0": hashlib.sha256(outs[0].tobytes()).hexdigest()[:16],
+        }, f, indent=1)
+    return vox_per_sec
+
+
+def _log(msg):
+    print(f"[bench:{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def child_main():
+    import numpy as np
+
+    _log("child start")
     xml = build_fixture()
+    _log("fixture ready")
     out = os.path.join(FIXTURE, "fused.ome.zarr")
+    baseline = measure_baseline(xml)
+    _log(f"baseline {baseline:.0f} vox/s")
     # warm-up: compiles all (block,patch,view) bucket variants
     run_fusion(xml, out)
+    _log("warmup fusion done")
     # measured steady-state run
-    stats = run_fusion(xml, out)
+    stats, ds, bbox = run_fusion(xml, out)
+    _log(f"measured fusion done: {stats.voxels} vox in {stats.seconds:.2f}s")
     vox_per_sec = stats.voxels / max(stats.seconds, 1e-9)
+    # validate: the XLA output must agree with the baseline implementation
+    # (same math, independent code path) on the first block
+    from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+    from bigstitcher_spark_tpu.io.spimdata import SpimData
+    from bigstitcher_spark_tpu.utils.geometry import Interval
+    from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+    sd = SpimData.load(xml)
+    loader = ViewLoader(sd)
+    bbox = maximal_bounding_box(sd, sd.view_ids())
+    blk = (128, 128, 64)
+    ref_blk = _baseline_fuse_block(
+        sd, loader, sd.view_ids(), Interval.from_shape(blk).translate(bbox.min))
+    got_blk = np.asarray(ds.read((0, 0, 0, 0, 0), (*blk, 1, 1)))[..., 0, 0]
+    diff = np.abs(got_blk.astype(np.float64) - ref_blk.astype(np.float64))
+    assert float(diff.mean()) < 1.0 and float(got_blk.std()) > 0.0, (
+        f"XLA fusion disagrees with baseline: mean|diff|={diff.mean():.3f}")
+    import jax
+
     print(json.dumps({
         "metric": "affine_fusion_voxels_per_sec",
         "value": round(vox_per_sec, 1),
         "unit": "voxel/s",
-        "vs_baseline": round(vox_per_sec / BASELINE_VOX_PER_SEC, 3),
+        "vs_baseline": round(vox_per_sec / baseline, 3),
+        "platform": jax.devices()[0].platform,
+        "baseline_vox_per_sec": round(baseline, 1),
+        "baseline_provenance": "BASELINE_MEASURED.json (measured, this host)",
     }))
+
+
+def _spawn_child(env_extra, label):
+    env = dict(os.environ)
+    env.update(env_extra)
+    env["BST_BENCH_CHILD"] = "1"
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, cwd=REPO, timeout=CHILD_TIMEOUT_S,
+            capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"[bench] {label}: timed out after {CHILD_TIMEOUT_S}s",
+              file=sys.stderr)
+        return None
+    dt = time.time() - t0
+    line = None
+    for ln in (proc.stdout or "").splitlines():
+        if ln.startswith("{") and '"metric"' in ln:
+            line = ln
+    if proc.returncode == 0 and line:
+        print(f"[bench] {label}: ok in {dt:.0f}s", file=sys.stderr)
+        return line
+    tail = "\n".join(((proc.stderr or "") + (proc.stdout or "")).splitlines()[-15:])
+    print(f"[bench] {label}: rc={proc.returncode} in {dt:.0f}s\n{tail}",
+          file=sys.stderr)
+    return None
+
+
+def _probe_tpu(timeout_s=300):
+    """Quickly check that the accelerator backend can initialize at all
+    before spending a full child timeout on it."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print('PROBE_OK', d[0].platform)"],
+            env=dict(os.environ), cwd=REPO, timeout=timeout_s,
+            capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"[bench] tpu probe: timed out after {timeout_s}s",
+              file=sys.stderr)
+        return False
+    ok = proc.returncode == 0 and "PROBE_OK" in (proc.stdout or "")
+    if not ok:
+        tail = "\n".join((proc.stderr or "").splitlines()[-5:])
+        print(f"[bench] tpu probe failed rc={proc.returncode}\n{tail}",
+              file=sys.stderr)
+    return ok
+
+
+def main():
+    if os.environ.get("BST_BENCH_CHILD"):
+        child_main()
+        return 0
+    attempts = []
+    if _probe_tpu():
+        for i in range(TPU_ATTEMPTS):
+            attempts.append(({}, f"tpu attempt {i + 1}/{TPU_ATTEMPTS}"))
+    else:
+        print("[bench] accelerator unreachable, going straight to cpu",
+              file=sys.stderr)
+    attempts.append((
+        {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+        "cpu fallback",
+    ))
+    for i, (env_extra, label) in enumerate(attempts):
+        line = _spawn_child(env_extra, label)
+        if line:
+            print(line)
+            return 0
+        if i + 1 < len(attempts):
+            time.sleep(10)
+    print("[bench] all attempts failed", file=sys.stderr)
+    return 1
 
 
 if __name__ == "__main__":

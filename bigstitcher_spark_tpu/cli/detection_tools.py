@@ -118,6 +118,16 @@ def detect_interestpoints_cmd(xml, dry_run, **kw):
               is_flag=True, help="match only points inside the pair overlap")
 @click.option("--clearCorrespondences", "clear_corrs", is_flag=True,
               help="drop existing correspondences instead of merging")
+@click.option("--groupTiles", "group_tiles", is_flag=True,
+              help="merge all tiles of one angle/channel/illum/timepoint")
+@click.option("--groupChannels", "group_channels", is_flag=True,
+              help="merge all channels of one angle/illum/tile/timepoint")
+@click.option("--groupIllums", "group_illums", is_flag=True,
+              help="merge all illuminations of one angle/channel/tile/timepoint")
+@click.option("--splitTimepoints", "split_timepoints", is_flag=True,
+              help="treat each timepoint as one grouped view")
+@click.option("--interestPointMergeDistance", "merge_distance", default=5.0,
+              type=float, help="merge radius (px) for grouped interest points")
 def match_interestpoints_cmd(xml, dry_run, **kw):
     """Distributed pairwise interest-point matching
     (SparkGeometricDescriptorMatching)."""
@@ -145,6 +155,10 @@ def match_interestpoints_cmd(xml, dry_run, **kw):
         reference_tp=kw["reference_tp"], range_tp=kw["range_tp"],
         interest_points_for_overlap_only=kw["overlap_only_points"],
         clear_correspondences=kw["clear_corrs"],
+        group_tiles=kw["group_tiles"], group_channels=kw["group_channels"],
+        group_illums=kw["group_illums"],
+        split_timepoints=kw["split_timepoints"],
+        merge_distance=kw["merge_distance"],
     )
     store = InterestPointStore.for_project(sd)
     results = match_interest_points(sd, views, params, store)

@@ -9,9 +9,13 @@ verified by hypothesis-parallel RANSAC (ops.descriptors). Inliers are stored
 symmetrically into interestpoints.n5 ``correspondences`` datasets — the
 exact format ``models.solver.matches_from_interest_points`` consumes.
 
-Reference parity notes: grouped matching (tile/channel/illum merging via
-InterestPointGroupingMinDistance, SparkGeometricDescriptorMatching.java:343-503)
-is not implemented yet — each view matches individually.
+Grouped matching (--groupChannels/--groupTiles/--groupIllums/
+--splitTimepoints): member views' interest points are pooled in world space,
+near-duplicates across views are merged within ``merge_distance`` px
+(InterestPointGroupingMinDistance role), the pooled clouds are matched as one
+pair, and the inliers are split back per original view pair — per-view lists
+smaller than the model's minimum match count are dropped
+(SparkGeometricDescriptorMatching.java:343-503).
 """
 
 from __future__ import annotations
@@ -58,6 +62,17 @@ class MatchingParams:
     overlap_filter: bool = True          # SimpleBoundingBoxOverlap vs all-against-all
     interest_points_for_overlap_only: bool = False
     clear_correspondences: bool = False
+    # grouping (SparkGeometricDescriptorMatching.java:115-129)
+    group_tiles: bool = False
+    group_channels: bool = False
+    group_illums: bool = False
+    split_timepoints: bool = False
+    merge_distance: float = 5.0          # --interestPointMergeDistance
+
+    @property
+    def grouped(self) -> bool:
+        return (self.group_tiles or self.group_channels or self.group_illums
+                or self.split_timepoints)
 
 
 @dataclass
@@ -154,6 +169,166 @@ def match_pair(
     return cand[inliers], model, len(cand)
 
 
+def build_match_groups(
+    sd: SpimData, views: list[ViewId], params: MatchingParams
+) -> list[tuple[ViewId, ...]]:
+    """Partition views into match groups: a view's group key keeps every
+    attribute EXCEPT the grouped ones (groups always stay within one
+    timepoint; --splitTimepoints merges everything per timepoint)."""
+    by_key: dict[tuple, list[ViewId]] = {}
+    for v in sorted(views):
+        s = sd.setups[v.setup]
+        if params.split_timepoints:
+            key = (v.timepoint,)
+        else:
+            key = (
+                v.timepoint,
+                s.attributes.get("angle", 0),
+                None if params.group_channels else s.attributes.get("channel", 0),
+                None if params.group_illums else s.attributes.get("illumination", 0),
+                None if params.group_tiles else s.attributes.get("tile", 0),
+            )
+        by_key.setdefault(key, []).append(v)
+    return [tuple(vs) for _, vs in sorted(by_key.items())]
+
+
+def _group_bbox(sd: SpimData, group: tuple[ViewId, ...]) -> Interval:
+    box = None
+    for v in group:
+        iv = transformed_interval(sd.model(v), Interval.from_shape(sd.view_size(v)))
+        box = iv if box is None else box.union(iv)
+    return box
+
+
+def plan_group_pairs(
+    sd: SpimData, groups: list[tuple[ViewId, ...]], params: MatchingParams
+) -> list[tuple[tuple[ViewId, ...], tuple[ViewId, ...]]]:
+    """Group-pair enumeration under the same timepoint policy + overlap
+    filter as the ungrouped path."""
+    boxes = [_group_bbox(sd, g) for g in groups]
+    policy = params.registration_tp.upper()
+    out = []
+    for i in range(len(groups)):
+        for j in range(i + 1, len(groups)):
+            ta, tb = groups[i][0].timepoint, groups[j][0].timepoint
+            if policy == INDIVIDUAL_TIMEPOINTS:
+                if ta != tb:
+                    continue
+            elif policy == ALL_TO_ALL_RANGE:
+                if abs(ta - tb) > params.range_tp:
+                    continue
+            elif policy == REFERENCE_TIMEPOINT:
+                if not (ta == tb or params.reference_tp in (ta, tb)):
+                    continue
+            if params.overlap_filter and not boxes[i].overlaps(boxes[j]):
+                continue
+            out.append((groups[i], groups[j]))
+    return out
+
+
+def merge_min_distance(
+    view_of: np.ndarray, ids: np.ndarray, world: np.ndarray, radius: float
+) -> np.ndarray:
+    """Keep-mask for pooled group points: a point is dropped when a point of
+    an EARLIER member view lies within ``radius`` (the near-duplicate beads
+    that views of one group see in their mutual overlap —
+    InterestPointGroupingMinDistance semantics, merge radius default 5 px)."""
+    from scipy.spatial import cKDTree
+
+    keep = np.ones(len(world), bool)
+    if len(world) == 0 or radius <= 0:
+        return keep
+    kept_pts: list[np.ndarray] = []
+    for uv in sorted(set(view_of.tolist())):
+        sel = view_of == uv
+        if kept_pts:
+            tree = cKDTree(np.concatenate(kept_pts))
+            d, _ = tree.query(world[sel], k=1)
+            keep[sel] = d > radius
+        if np.any(keep & sel):
+            kept_pts.append(world[keep & sel])
+    return keep
+
+
+def _match_grouped(
+    sd: SpimData,
+    views: list[ViewId],
+    params: MatchingParams,
+    store: InterestPointStore,
+    progress: bool,
+) -> list[PairMatchResult]:
+    """Grouped matching: pool member views' points, merge near-duplicates,
+    match once per group pair, split inliers back per view pair
+    (SparkGeometricDescriptorMatching.java:343-503)."""
+    groups = build_match_groups(sd, views, params)
+    pairs = plan_group_pairs(sd, groups, params)
+    if progress:
+        print(f"matching (grouped): {len(groups)} groups, {len(pairs)} group "
+              f"pairs, merge distance {params.merge_distance}")
+
+    cache: dict[ViewId, tuple[np.ndarray, np.ndarray]] = {}
+
+    def world(view: ViewId):
+        if view not in cache:
+            ids, locs = store.load_points(view, params.label)
+            w = apply_affine(sd.model(view), locs) if len(locs) else locs
+            cache[view] = (ids, w)
+        return cache[view]
+
+    def pooled(group: tuple[ViewId, ...]):
+        view_of, ids, pts = [], [], []
+        for k, v in enumerate(group):
+            i, w = world(v)
+            view_of.append(np.full(len(i), k, np.int32))
+            ids.append(i)
+            pts.append(w)
+        view_of = np.concatenate(view_of) if view_of else np.zeros(0, np.int32)
+        ids = np.concatenate(ids) if ids else np.zeros(0, np.uint64)
+        pts = (np.concatenate(pts) if pts else np.zeros((0, 3), np.float64))
+        keep = merge_min_distance(view_of, ids, pts, params.merge_distance)
+        return view_of[keep], ids[keep], pts[keep]
+
+    min_matches = M.MIN_POINTS[params.model]
+    results: list[PairMatchResult] = []
+    for k, (ga, gb) in enumerate(pairs):
+        va_of, ids_a, wa = pooled(ga)
+        vb_of, ids_b, wb = pooled(gb)
+        if params.interest_points_for_overlap_only:
+            # group = one unit: filter to the GROUP overlap bbox, never
+            # within a group (SparkGeometricDescriptorMatching.java:404-411)
+            ov = _group_bbox(sd, ga).intersect(_group_bbox(sd, gb)).expand(2)
+            if ov.is_empty():
+                continue
+            ka = np.all((wa >= np.array(ov.min)) & (wa <= np.array(ov.max)),
+                        axis=1) if len(wa) else np.zeros(0, bool)
+            kb = np.all((wb >= np.array(ov.min)) & (wb <= np.array(ov.max)),
+                        axis=1) if len(wb) else np.zeros(0, bool)
+            va_of, ids_a, wa = va_of[ka], ids_a[ka], wa[ka]
+            vb_of, ids_b, wb = vb_of[kb], ids_b[kb], wb[kb]
+        with profiling.span("matching.group_pair"):
+            inl, model, n_cand = match_pair(wa, wb, params, seed=17 + k)
+        if progress:
+            print(f"  group {ga[0]}x{len(ga)} <-> {gb[0]}x{len(gb)}: "
+                  f"{len(inl)} inliers / {n_cand} candidates")
+        # split grouped inliers per original (viewA, viewB) pair
+        per_pair: dict[tuple[ViewId, ViewId], list[tuple[int, int]]] = {}
+        for ia, ib in inl:
+            pair = (ga[va_of[ia]], gb[vb_of[ib]])
+            per_pair.setdefault(pair, []).append((int(ids_a[ia]), int(ids_b[ib])))
+        for (va, vb), id_pairs in sorted(per_pair.items()):
+            if len(id_pairs) < min_matches:
+                if progress:
+                    print(f"    {va} <-> {vb}: {len(id_pairs)} correspondences "
+                          "(omitted: fewer than the model minimum)")
+                continue
+            arr = np.array(id_pairs, np.uint64)
+            results.append(PairMatchResult(
+                va, vb, arr[:, 0], arr[:, 1], model, n_cand))
+            if progress:
+                print(f"    {va} <-> {vb}: {len(id_pairs)} correspondences")
+    return results
+
+
 def match_interest_points(
     sd: SpimData,
     views: list[ViewId],
@@ -165,6 +340,8 @@ def match_interest_points(
     persisted (use ``save_matches``)."""
     params = params or MatchingParams()
     store = store or InterestPointStore.for_project(sd)
+    if params.grouped:
+        return _match_grouped(sd, views, params, store, progress)
     pairs = plan_match_pairs(sd, views, params)
     if progress:
         print(f"matching: {len(pairs)} view pairs, method {params.method}, "

@@ -54,6 +54,11 @@ class StitchingParams:
     channel_combine: str = "AVERAGE"        # AVERAGE | PICK_BRIGHTEST
     illum_combine: str = "PICK_BRIGHTEST"   # AVERAGE | PICK_BRIGHTEST
     min_overlap_px: int = 32
+    # candidate shifts must keep at least this fraction of the overlap crop
+    # in play: a near-total shift can score a HIGHER Pearson r than the true
+    # one by chance over a few thousand background voxels (observed on the
+    # 2x2 fixture's corner pairs at full resolution)
+    min_overlap_frac: float = 0.25
     batch_size: int = 16
 
 
@@ -324,7 +329,9 @@ def _stitch_one_bucket(sd, jobs: list[_PairJob], shp, params) -> list[PairwiseSt
     ext_a = np.stack([np.array(j.crop_a.shape, np.int32) for j in jobs])
     ext_b = np.stack([np.array(j.crop_b.shape, np.int32) for j in jobs])
     min_ov = np.array(
-        [max(params.min_overlap_px, 0.1 * int(np.prod(j.crop_a.shape)))
+        [max(params.min_overlap_px,
+             params.min_overlap_frac
+             * min(int(np.prod(j.crop_a.shape)), int(np.prod(j.crop_b.shape))))
          for j in jobs], np.float32,
     )
     with profiling.span("stitching.kernel"):

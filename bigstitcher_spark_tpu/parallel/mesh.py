@@ -4,20 +4,29 @@ TPU-native replacement of the reference's Spark data parallelism (§2.4 P1):
 a batch of output blocks becomes the leading axis of the stacked kernel
 inputs, sharded over a 1-D ``jax.sharding.Mesh`` — each device fuses its
 shard of blocks; no collectives are needed because block writes are disjoint
-(the reference's no-shuffle property). Multi-host scale-out uses the same
-mesh spanning hosts (ICI within pod, DCN across — jax.distributed).
+(the reference's no-shuffle property, the Spark map at
+SparkAffineFusion.java:480-482). Multi-host scale-out uses the same mesh
+spanning hosts (ICI within pod, DCN across — jax.distributed).
+
+``make_sharded_fuser`` serves the production per-block fusion driver
+(models/affine_fusion.fuse_volume with devices > 1): both the general
+gather kernel and the translation shifted-slice kernel batch over blocks,
+with intensity conversion fused into the same device computation so each
+block crosses the host boundary exactly twice (patch in, converted block
+out).
 """
 
 from __future__ import annotations
 
-import functools
+
 from typing import Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.fusion import fuse_block_impl
+from ..ops import fusion as F
 
 BLOCK_AXIS = "blocks"
 
@@ -33,19 +42,56 @@ def make_sharded_fuser(
     mesh: Mesh,
     block_shape: tuple[int, int, int],
     fusion_type: str = "AVG_BLEND",
+    kernel: str = "gather",           # gather | shift
+    with_coeffs: bool = False,
+    out_dtype: str | None = None,     # fuse intensity conversion on device
+    masks: bool = False,
 ):
     """Compile a fuser for a BATCH of blocks sharded over the mesh.
 
-    Inputs get a leading batch axis B (must be a multiple of mesh size; pad
-    with valid=0 blocks). Returns (fused (B,*block_shape), weights)."""
+    Inputs get a leading batch axis B (a multiple of mesh size; pad with
+    valid=0 blocks). Returns ``fn(*arrays) -> (out (B,*block_shape), wsum)``
+    where ``out`` is already intensity-converted when ``out_dtype`` is given
+    (min_i/max_i are appended scalar args in that case)."""
+    if kernel == "gather":
+        def core(p, a, o, d, b, r, v, io, c=None, ca=None):
+            return F.fuse_block_impl(
+                p, a, o, d, b, r, v, block_shape=block_shape,
+                fusion_type=fusion_type, inside_offs=io, coeffs=c,
+                coeff_affines=ca,
+            )
+
+        n_in = 10 if with_coeffs else 8
+    elif kernel == "shift":
+        def core(p, f, l, d, b, r, v, io):  # noqa: E741
+            return F.fuse_block_shift_impl(
+                p, f, l, d, b, r, v, block_shape=block_shape,
+                fusion_type=fusion_type, inside_offs=io,
+            )
+
+        n_in = 8
+    else:
+        raise ValueError(f"unknown kernel {kernel}")
+
+    def one(args, min_i, max_i):
+        fused, wsum = core(*args)
+        if masks:
+            fused = (wsum > 0).astype(jnp.float32)
+            if out_dtype is not None and out_dtype != "float32":
+                fused = (fused * float(np.iinfo(np.dtype(out_dtype)).max)
+                         ).astype(np.dtype(out_dtype))
+        elif out_dtype is not None:
+            fused = F._convert_intensity_expr(fused, min_i, max_i, out_dtype)
+        return fused, wsum
+
+    def batched(min_i, max_i, *arrays):
+        return jax.vmap(lambda *a: one(a, min_i, max_i))(*arrays)
+
     shard = NamedSharding(mesh, P(BLOCK_AXIS))
-    core = functools.partial(
-        fuse_block_impl, block_shape=block_shape, fusion_type=fusion_type
-    )
-    batched = jax.vmap(core)
+    repl = NamedSharding(mesh, P())
     return jax.jit(
         batched,
-        in_shardings=(shard,) * 7,
+        in_shardings=(repl, repl) + (shard,) * n_in,
         out_shardings=(shard, shard),
     )
 

@@ -22,6 +22,14 @@ if not os.environ.get("BST_TEST_TPU"):
         os.environ["XLA_FLAGS"] = (
             _flags + " --xla_force_host_platform_device_count=8"
         ).strip()
+    # The env vars alone are NOT enough: the axon sitecustomize imports jax
+    # at interpreter startup with JAX_PLATFORMS=axon already latched into
+    # jax.config, so without this update the whole suite silently targets
+    # the one-client TPU tunnel (slow remote compiles, cross-process
+    # blocking). Must happen before any backend is initialized.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -214,6 +214,142 @@ class TestMatchingPipeline:
             )
 
 
+class TestGroupedMatching:
+    def test_merge_min_distance(self):
+        from bigstitcher_spark_tpu.models.matching import merge_min_distance
+
+        pts = np.array([
+            [0.0, 0.0, 0.0], [50.0, 0.0, 0.0],      # view 0
+            [0.2, 0.1, 0.0], [80.0, 0.0, 0.0],      # view 1: dup of p0 + new
+            [50.1, 0.0, 0.1], [0.1, 0.0, 0.1],      # view 2: dups of p1, p0
+        ])
+        view_of = np.array([0, 0, 1, 1, 2, 2])
+        ids = np.arange(6, dtype=np.uint64)
+        keep = merge_min_distance(view_of, ids, pts, radius=5.0)
+        assert keep.tolist() == [True, True, False, True, False, False]
+        # radius 0 disables merging
+        assert merge_min_distance(view_of, ids, pts, radius=0.0).all()
+
+    @pytest.fixture(scope="class")
+    def two_channel_project(self, tmp_path_factory):
+        """2 tiles x 2 channels with SYNTHETIC interest points: each channel
+        sees a disjoint half of the global bead set (deterministic, and the
+        realistic case where grouping helps — each channel alone has too few
+        points in the overlap)."""
+        from bigstitcher_spark_tpu.io.interestpoints import InterestPointStore
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+        proj = make_synthetic_project(
+            str(tmp_path_factory.mktemp("grouped") / "proj"),
+            n_tiles=(2, 1, 1), tile_size=(96, 96, 48), overlap=40,
+            jitter=2.0, seed=21, n_beads_per_tile=120, n_channels=2,
+        )
+        sd = SpimData.load(proj.xml_path)
+        views = sorted(sd.registrations)
+        store = InterestPointStore.for_project(sd)
+        beads = proj.bead_positions
+        for v in views:
+            ch = sd.setups[v.setup].attributes["channel"]
+            sel = beads[ch::2]  # channel 0 -> even beads, channel 1 -> odd
+            local = sel - proj.true_offsets[v.setup]
+            size = np.array(sd.view_size(v), float)
+            inside = np.all((local >= 1) & (local <= size - 2), axis=1)
+            pts = local[inside]
+            path = store.save_points(v, "beads", pts)
+            from bigstitcher_spark_tpu.models.detection import (
+                register_points_in_xml,
+            )
+            register_points_in_xml(sd, v, "beads", "synthetic", path)
+        sd.save(proj.xml_path)
+        return proj, sd, store, views
+
+    def test_group_channels_matches_both_channels(self, two_channel_project):
+        """--groupChannels pools both channels per tile; the split-back
+        produces correspondences for views of BOTH channels
+        (SparkGeometricDescriptorMatching.java:343-503)."""
+        from bigstitcher_spark_tpu.models.matching import (
+            MatchingParams, match_interest_points, save_matches,
+        )
+
+        proj, sd, store, views = two_channel_project
+        params = MatchingParams(
+            group_channels=True, method="PRECISE_TRANSLATION",
+            interest_points_for_overlap_only=True,
+            ransac_min_inliers=5, ransac_iterations=2000,
+        )
+        results = match_interest_points(sd, views, params, store,
+                                        progress=False)
+        assert results, "no grouped match results"
+        channels_covered = {
+            sd.setups[r.view_a.setup].attributes["channel"] for r in results
+        } | {
+            sd.setups[r.view_b.setup].attributes["channel"] for r in results
+        }
+        assert channels_covered == {0, 1}
+        # correspondences stay within one channel here (disjoint bead sets)
+        for r in results:
+            assert (sd.setups[r.view_a.setup].attributes["channel"]
+                    == sd.setups[r.view_b.setup].attributes["channel"])
+        # every correspondence links the same physical bead (<2 px in truth)
+        for r in results:
+            ids_a, locs_a = store.load_points(r.view_a, "beads")
+            ids_b, locs_b = store.load_points(r.view_b, "beads")
+            la = {int(i): p for i, p in zip(ids_a, locs_a)}
+            lb = {int(i): p for i, p in zip(ids_b, locs_b)}
+            offa = proj.true_offsets[r.view_a.setup]
+            offb = proj.true_offsets[r.view_b.setup]
+            d = [np.linalg.norm((la[int(ia)] + offa) - (lb[int(ib)] + offb))
+                 for ia, ib in zip(r.ids_a, r.ids_b)]
+            assert np.median(d) < 1.5
+        save_matches(sd, store, results, params,  views)
+
+    def test_merge_distance_drops_cross_view_duplicates(
+            self, two_channel_project):
+        """Points duplicated across a group's member views within the merge
+        radius collapse to one pooled point (countBefore >>> countAfter)."""
+        from bigstitcher_spark_tpu.models.matching import (
+            MatchingParams, build_match_groups, merge_min_distance,
+        )
+        from bigstitcher_spark_tpu.utils.geometry import apply_affine
+
+        proj, sd, store, views = two_channel_project
+        params = MatchingParams(group_channels=True)
+        groups = build_match_groups(sd, views, params)
+        assert len(groups) == 2 and all(len(g) == 2 for g in groups)
+        g = groups[0]
+        view_of, pts = [], []
+        for k, v in enumerate(g):
+            ids, locs = store.load_points(v, "beads")
+            w = apply_affine(sd.model(v), locs)
+            view_of.append(np.full(len(ids), k, np.int32))
+            pts.append(w)
+        # duplicate view 0's cloud as if view 1 re-detected the same beads
+        view_of.append(np.full(len(pts[0]), 1, np.int32))
+        pts.append(pts[0] + 0.3)
+        view_of = np.concatenate(view_of)
+        pts = np.concatenate(pts)
+        keep = merge_min_distance(
+            view_of, np.arange(len(pts), dtype=np.uint64), pts, 5.0)
+        n0 = int((view_of == 0).sum())
+        # all injected duplicates dropped, non-duplicate points kept
+        assert keep.sum() == len(pts) - n0
+
+    def test_cli_grouped_flags(self, two_channel_project):
+        from bigstitcher_spark_tpu.cli.main import cli
+
+        proj, _, _, _ = two_channel_project
+        runner = CliRunner()
+        res = runner.invoke(cli, [
+            "match-interestpoints", "-x", proj.xml_path, "--groupChannels",
+            "--interestPointMergeDistance", "0",
+            "--ransacMinNumInliers", "5", "--ransacIterations", "2000",
+            "--dryRun",
+        ], catch_exceptions=False)
+        assert res.exit_code == 0, res.output
+        assert "grouped" in res.output
+
+
 def test_cli_match(tmp_path):
     from bigstitcher_spark_tpu.cli.main import cli
     from bigstitcher_spark_tpu.io.interestpoints import InterestPointStore

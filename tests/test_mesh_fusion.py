@@ -1,0 +1,79 @@
+"""Multi-device (virtual 8-CPU mesh) fusion: the production sharded driver
+must produce byte-identical output to the single-device per-block path for
+both the shift and gather kernels (VERDICT r1 item 3; replaces the Spark map
+at SparkAffineFusion.java:480-482)."""
+
+import numpy as np
+import pytest
+
+from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+from bigstitcher_spark_tpu.io.spimdata import SpimData
+from bigstitcher_spark_tpu.models.affine_fusion import fuse_volume
+from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+
+@pytest.fixture(scope="module")
+def project(tmp_path_factory):
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    return make_synthetic_project(
+        str(tmp_path_factory.mktemp("mesh") / "proj"),
+        n_tiles=(2, 2, 1), tile_size=(48, 48, 24), overlap=12,
+        jitter=2.0, seed=13, block_size=(16, 16, 8), n_beads_per_tile=15,
+    )
+
+
+def _fuse(project, tmp_path, name, **kw):
+    sd = SpimData.load(project.xml_path)
+    loader = ViewLoader(sd)
+    views = sd.view_ids()
+    bbox = maximal_bounding_box(sd, views)
+    store = ChunkStore.create(str(tmp_path / f"{name}.n5"), StorageFormat.N5)
+    ds = store.create_dataset("fused", bbox.shape, (16, 16, 8), "uint16")
+    stats = fuse_volume(
+        sd, loader, views, ds, bbox, block_size=(16, 16, 8),
+        block_scale=(2, 2, 1), out_dtype="uint16", **kw,
+    )
+    return ds.read_full(), stats
+
+
+def test_sharded_equals_single_device_shift(project, tmp_path):
+    import jax
+
+    assert len(jax.devices()) >= 8, "conftest must provide the 8-device mesh"
+    multi, ms = _fuse(project, tmp_path, "multi", devices=8)
+    single, ss = _fuse(project, tmp_path, "single", devices=1,
+                       device_resident=False)
+    assert multi.std() > 0
+    assert (multi == single).all()
+    assert ms.voxels == ss.voxels > 0
+
+
+def test_sharded_equals_single_device_gather(project, tmp_path):
+    """anisotropy != 1 forces the general gather kernel on every block."""
+    multi, _ = _fuse(project, tmp_path, "multi_g", devices=8,
+                     anisotropy_factor=2.0)
+    single, _ = _fuse(project, tmp_path, "single_g", devices=1,
+                      device_resident=False, anisotropy_factor=2.0)
+    assert multi.std() > 0
+    assert (multi == single).all()
+
+
+def test_sharded_masks_mode(project, tmp_path):
+    multi, _ = _fuse(project, tmp_path, "multi_m", devices=8, masks=True)
+    single, _ = _fuse(project, tmp_path, "single_m", devices=1,
+                      device_resident=False, masks=True)
+    assert set(np.unique(multi)) <= {0, 65535}
+    assert (multi == single).all()
+
+
+def test_sharded_device_scan_agrees(project, tmp_path):
+    """The single-device whole-volume scan path and the sharded per-block
+    path agree (same math, different dispatch)."""
+    multi, _ = _fuse(project, tmp_path, "multi_s", devices=8)
+    scan, st = _fuse(project, tmp_path, "scan", devices=1)
+    assert any("scan" in str(k) for k in st.compile_keys), \
+        "single-device run did not take the device-resident scan path"
+    diff = np.abs(multi.astype(np.int64) - scan.astype(np.int64))
+    assert diff.max() <= 1  # rounding at f32 accumulation order boundaries
