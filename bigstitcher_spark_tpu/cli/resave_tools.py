@@ -18,7 +18,12 @@ import numpy as np
 from ..io.chunkstore import ChunkStore, StorageFormat
 from ..io.dataset_io import ViewLoader
 from ..io.spimdata import SpimData
-from ..models.downsample_driver import downsample_write_block, validate_pyramid
+from ..models.downsample_driver import (
+    _convert_to_dtype,
+    read_padded,
+    run_sharded_downsample,
+    validate_pyramid,
+)
 from ..models.resave import propose_pyramid, resave, swap_imgloader
 from ..parallel.retry import run_with_retry
 from ..utils.grid import create_grid
@@ -162,11 +167,17 @@ def downsample_cmd(path_in, dataset_in, datasets_out, downsampling,
         compute_block = [b * s for b, s in zip(dst.block_size, bscale)]
         grid = create_grid(dims, compute_block, dst.block_size)
 
-        def process(blk, src_ds=prev, dst_ds=dst, f=tuple(step)):
-            downsample_write_block(src_ds, dst_ds, blk, f)
+        def read_job(blk, src_ds=prev, f=tuple(step)):
+            src_off = [o * x for o, x in zip(blk.offset, f)]
+            src_size = [s * x for s, x in zip(blk.size, f)]
+            return read_padded(src_ds.read, src_ds.shape, src_off, src_size)
 
-        run_with_retry(grid, process, label=f"downsample block ({out_path})",
-                       threads=threads)
+        def write_job(blk, out, dst_ds=dst):
+            dst_ds.write(_convert_to_dtype(out, dst_ds.dtype), blk.offset)
+
+        run_sharded_downsample(grid, read_job, write_job, tuple(step),
+                               io_threads=threads,
+                               label=f"downsample block ({out_path})")
         click.echo(f"  wrote {out_path} {tuple(dims)}")
         prev = dst
 

@@ -407,8 +407,7 @@ def _fuse_volume_sharded(
     no locks (the reference's no-shuffle invariant)."""
     from concurrent.futures import ThreadPoolExecutor
 
-    from ..parallel.mesh import make_mesh, make_sharded_fuser, pad_batch
-    from ..parallel.retry import run_with_retry
+    from ..parallel.mesh import make_mesh, make_sharded_fuser, run_sharded_batches
 
     grid = create_grid(bbox.shape, compute_block, compute_block)
     inside_offset = mask_offset if masks else (0.0, 0.0, 0.0)
@@ -464,39 +463,23 @@ def _fuse_volume_sharded(
                         arrs = arrs[:8]
                 return arrs
 
-            batches = [items[i:i + n_dev] for i in range(0, len(items), n_dev)]
-            prefetched = {0: [pool.submit(build, it) for it in batches[0]]}
+            def kernel_call(*stacked):
+                with profiling.span("fusion.kernel"):
+                    out, _wsum = fuser(mi, ma, *stacked)
+                    return np.asarray(out)
+
             written: dict[tuple, int] = {}
 
-            def process_batch(bi_batch):
-                bi, batch = bi_batch
-                futs = prefetched.pop(bi, None)
-                if futs is None:  # retry round: prefetch again
-                    futs = [pool.submit(build, it) for it in batch]
-                if bi + 1 < len(batches) and bi + 1 not in prefetched:
-                    prefetched[bi + 1] = [
-                        pool.submit(build, it) for it in batches[bi + 1]]
-                inputs = [f.result() for f in futs]
-                n_arr = len(inputs[0])
-                stacked = [np.stack([inp[j] for inp in inputs])
-                           for j in range(n_arr)]
-                stacked = pad_batch(stacked, n_dev)
-                with profiling.span("fusion.kernel"):
-                    out, wsum = fuser(mi, ma, *stacked)
-                    out = np.asarray(out)
-                wfuts = []
-                for (block, bg, plans), data in zip(batch, out):
-                    sl = tuple(slice(0, s) for s in block.size)
-                    wfuts.append(pool.submit(
-                        _write_block, out_ds, data[sl], block, zarr_ct))
-                    written[tuple(block.offset)] = int(np.prod(block.size))
-                for w in wfuts:
-                    w.result()
-                if progress:
-                    print(f"  bucket {key}: batch {bi + 1}/{len(batches)} done")
+            def consume(item, data):
+                block, bg, plans = item
+                sl = tuple(slice(0, s) for s in block.size)
+                _write_block(out_ds, data[sl], block, zarr_ct)
+                written[tuple(block.offset)] = int(np.prod(block.size))
 
-            run_with_retry(list(enumerate(batches)), process_batch,
-                           label=f"fusion batch {key}")
+            run_sharded_batches(
+                items, build, kernel_call, consume, n_dev, pool,
+                label=f"fusion batch {key}", progress=progress,
+            )
             stats.voxels += sum(written.values())
     finally:
         pool.shutdown(wait=True)

@@ -22,7 +22,7 @@ from ..io.dataset_io import ViewLoader
 from ..io.interestpoints import InterestPointStore
 from ..io.spimdata import SpimData, ViewId
 from ..ops import fusion as F
-from ..ops.nonrigid import fit_control_grid, nonrigid_fuse_block
+from ..ops.nonrigid import fit_control_grid
 from ..utils.geometry import (
     Interval,
     apply_affine,
@@ -143,8 +143,11 @@ def fuse_nonrigid_volume(
     max_intensity: float | None = None,
     zarr_ct: tuple[int, int] | None = None,
     progress: bool = False,
+    devices: int | None = None,
+    io_threads: int = 4,
 ) -> FusionStats:
-    """Fuse ``views`` non-rigidly into ``out_ds`` over ``bbox``."""
+    """Fuse ``views`` non-rigidly into ``out_ds`` over ``bbox``, block-sharded
+    over the local device mesh (``devices`` defaults to all)."""
     stats = FusionStats()
     t0 = time.time()
     blend = blend or BlendParams()
@@ -163,43 +166,109 @@ def fuse_nonrigid_volume(
     # spacing before the block, dims covering block + margins
     gdims = tuple(int(np.ceil(compute_block[d] / cpd)) + 3 for d in range(3))
 
-    def process(block: GridBlock) -> None:
-        res = _fuse_one_block(
-            sd, loader, views, unique, block, bbox, compute_block, gdims,
-            cpd, alpha, fusion_type, blend, aniso, stats,
-        )
+    import jax
+
+    from ..parallel.mesh import run_sharded_batches
+
+    n_dev = devices if devices is not None else len(jax.devices())
+
+    # plan every block up front (host geometry + control-grid fits), then
+    # bucket by compiled-kernel signature and batch over the device mesh —
+    # the reference's per-block Spark foreach (SparkNonRigidFusion.java:313-435)
+    planned = []
+    for block in grid_blocks:
         stats.blocks += 1
+        res = _plan_nonrigid_block(
+            sd, views, unique, block, bbox, compute_block, gdims, cpd, alpha,
+            aniso)
         if res is None:
             stats.skipped_empty += 1
-            return
-        fused = np.asarray(
-            F.convert_intensity(
-                res, np.float32(min_intensity), np.float32(max_intensity),
-                out_dtype=out_dtype,
-            )
-        )
-        with profiling.span("nonrigid.write"):
-            if zarr_ct is not None:
-                c, t = zarr_ct
-                out_ds.write(fused[..., None, None], (*block.offset, c, t))
-            else:
-                out_ds.write(fused, block.offset)
-        stats.voxels += int(np.prod(block.size))
-        if progress:
-            print(f"  block {block.offset} done")
+            continue
+        planned.append((block, *res))
 
-    from ..parallel.retry import run_with_retry
+    buckets: dict[tuple, list] = {}
+    for item in planned:
+        plans = item[3]
+        vb = F.bucket_views(len(plans))
+        pshape = F.bucket_shape(np.max([p[3].shape for p in plans], axis=0), 32)
+        buckets.setdefault((pshape, vb), []).append(item)
 
-    run_with_retry(grid_blocks, process, label="nonrigid block")
+    mi, ma = np.float32(min_intensity), np.float32(max_intensity)
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=max(1, io_threads))
+    try:
+        for (pshape, vb), items in sorted(buckets.items(),
+                                          key=lambda kv: str(kv[0])):
+            kernel = _make_nonrigid_kernel(
+                n_dev, compute_block, fusion_type, out_dtype)
+            stats.compile_keys.add((tuple(compute_block), pshape, vb,
+                                    fusion_type, "nonrigid", n_dev > 1))
+
+            def build(item, _pshape=pshape, _vb=vb):
+                block, block_global, grid_origin, plans = item
+                arrs = _stage_nonrigid(loader, plans, _pshape, _vb, blend,
+                                       gdims)
+                return (*arrs,
+                        np.asarray(block_global.min, np.float32),
+                        np.asarray(grid_origin, np.float32),
+                        np.full(3, cpd, np.float32))
+
+            def kernel_call(*stacked):
+                with profiling.span("nonrigid.kernel"):
+                    return kernel(mi, ma, *stacked)
+
+            written: dict[tuple, int] = {}
+
+            def consume(item, data):
+                block = item[0]
+                sl = tuple(slice(0, s) for s in block.size)
+                with profiling.span("nonrigid.write"):
+                    if zarr_ct is not None:
+                        c, t = zarr_ct
+                        out_ds.write(data[sl][..., None, None],
+                                     (*block.offset, c, t))
+                    else:
+                        out_ds.write(data[sl], block.offset)
+                written[tuple(block.offset)] = int(np.prod(block.size))
+
+            run_sharded_batches(items, build, kernel_call, consume, n_dev,
+                                pool, label="nonrigid batch",
+                                progress=progress)
+            stats.voxels += sum(written.values())
+    finally:
+        pool.shutdown(wait=True)
     stats.seconds = time.time() - t0
     return stats
 
 
-def _fuse_one_block(
-    sd, loader, views, unique: UniquePoints, block: GridBlock, bbox: Interval,
-    compute_block, gdims, cpd, alpha, fusion_type, blend: BlendParams, aniso,
-    stats: FusionStats,
+def _make_nonrigid_kernel(n_dev, compute_block, fusion_type, out_dtype):
+    """Batch-of-blocks nonrigid fusion kernel with on-device intensity
+    conversion; batch axis sharded over the mesh when n_dev > 1."""
+    import jax
+
+    from ..ops.nonrigid import nonrigid_fuse_block_impl
+    from ..parallel.mesh import make_mesh, shard_jit
+
+    def one(mi, ma, *args):
+        fused, _ = nonrigid_fuse_block_impl(
+            *args, block_shape=tuple(compute_block), fusion_type=fusion_type)
+        return F._convert_intensity_expr(fused, mi, ma, out_dtype)
+
+    def batched(mi, ma, *arrays):
+        return jax.vmap(lambda *a: one(mi, ma, *a))(*arrays)
+
+    if n_dev <= 1:
+        return jax.jit(batched)
+    return shard_jit(batched, make_mesh(n_dev), n_in=11, n_repl=2)
+
+
+def _plan_nonrigid_block(
+    sd, views, unique: UniquePoints, block: GridBlock, bbox: Interval,
+    compute_block, gdims, cpd, alpha, aniso,
 ):
+    """Select + fit the views contributing to one block; returns
+    (block_global, grid_origin, plans) or None when nothing overlaps."""
     block_global = Interval.from_shape(compute_block, block.offset
                                        ).translate(bbox.min)
     grid_origin = np.asarray(block_global.min, np.float64) - cpd
@@ -251,11 +320,11 @@ def _fuse_one_block(
 
     if not plans:
         return None
+    return block_global, grid_origin, plans
 
-    vb = F.bucket_views(len(plans))
-    pshape = F.bucket_shape(
-        np.max([p[3].shape for p in plans], axis=0), 32
-    )
+
+def _stage_nonrigid(loader, plans, pshape, vb, blend: BlendParams, gdims):
+    """Host-side input staging for one block's nonrigid kernel inputs."""
     patches = np.zeros((vb, *pshape), np.float32)
     grids = np.zeros((vb, *gdims, 12), np.float32)
     grids[..., 0] = 1.0
@@ -281,19 +350,5 @@ def _fuse_one_block(
         borders[i] = blend.border
         ranges[i] = blend.range
         valid[i] = 1.0
-
-    if stats is not None:
-        stats.compile_keys.add((tuple(compute_block), pshape, vb,
-                                fusion_type, "nonrigid"))
-    with profiling.span("nonrigid.kernel"):
-        fused, _ = nonrigid_fuse_block(
-            patches, grids, vaffines, offsets, img_dims, borders, ranges,
-            valid,
-            np.asarray(block_global.min, np.float32),
-            np.asarray(grid_origin, np.float32),
-            np.full(3, cpd, np.float32),
-            block_shape=tuple(compute_block), fusion_type=fusion_type,
-        )
-        fused = np.asarray(fused)
-    sl = tuple(slice(0, s) for s in block.size)
-    return fused[sl]
+    return (patches, grids, vaffines, offsets, img_dims, borders, ranges,
+            valid)

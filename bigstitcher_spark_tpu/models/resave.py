@@ -23,7 +23,12 @@ from ..io.dataset_io import ViewLoader, bdv_dataset_path, create_bdv_view_datase
 from ..io.spimdata import ImageLoader, SpimData, ViewId
 from ..parallel.retry import run_with_retry
 from ..utils.grid import create_grid
-from .downsample_driver import downsample_write_block, validate_pyramid
+from .downsample_driver import (
+    _convert_to_dtype,
+    read_padded,
+    run_sharded_downsample,
+    validate_pyramid,
+)
 
 
 @dataclass
@@ -53,6 +58,7 @@ def resave(
     compression: str = "zstd",
     threads: int = 8,
     dry_run: bool = False,
+    devices: int | None = None,
 ) -> ResaveStats:
     """Copy ``views`` into a BDV-layout container at ``out_path``.
 
@@ -97,21 +103,31 @@ def resave(
     run_with_retry(s0_jobs, copy_s0, label="resave s0 block", threads=threads)
     stats.s0_blocks = len(s0_jobs)
 
-    # pyramid levels from the previous level (SparkResaveN5.java:336-415)
+    # pyramid levels from the previous level, block-sharded over the device
+    # mesh across ALL views at once (SparkResaveN5.java:336-415)
     for lvl in range(1, len(downsamplings)):
-        level_jobs: list[tuple[ViewId, object, object]] = []
+        level_jobs: list[tuple[ViewId, object]] = []
         for v in views:
             dst = per_view_datasets[v][lvl]
             for blk in create_grid(dst.shape, compute_block, block_size):
-                level_jobs.append((v, blk, lvl))
+                level_jobs.append((v, blk))
+        f = tuple(int(x) for x in rel[lvl])
 
-        def downsample_job(job):
-            v, blk, level = job
-            downsample_write_block(per_view_datasets[v][level - 1],
-                                   per_view_datasets[v][level], blk, rel[level])
+        def read_job(job, level=lvl, f=f):
+            v, blk = job
+            src = per_view_datasets[v][level - 1]
+            src_off = [o * x for o, x in zip(blk.offset, f)]
+            src_size = [s * x for s, x in zip(blk.size, f)]
+            return read_padded(src.read, src.shape, src_off, src_size)
 
-        run_with_retry(level_jobs, downsample_job,
-                       label=f"resave s{lvl} block", threads=threads)
+        def write_job(job, out, level=lvl):
+            v, blk = job
+            dst = per_view_datasets[v][level]
+            dst.write(_convert_to_dtype(out, dst.dtype), blk.offset)
+
+        run_sharded_downsample(level_jobs, read_job, write_job, f,
+                               devices=devices, io_threads=threads,
+                               label=f"resave s{lvl} block")
         stats.pyramid_blocks += len(level_jobs)
 
     stats.seconds = time.time() - t0

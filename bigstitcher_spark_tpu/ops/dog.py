@@ -122,19 +122,22 @@ def dog_block(
     return dog, mask
 
 
-@functools.partial(
-    jax.jit, static_argnames=("sigma", "find_max", "find_min")
-)
-def dog_block_batch(blocks, min_i, max_i, threshold, sigma,
-                    find_max=True, find_min=False, origins=None):
+def dog_block_batch_impl(blocks, min_i, max_i, threshold, sigma,
+                         find_max=True, find_min=False, origins=None):
     """vmapped ``dog_block`` over a leading batch axis (one compile serves
-    every equally-shaped block of every view — strategy P3 of SURVEY §2.4)."""
+    every equally-shaped block of every view — strategy P3 of SURVEY §2.4).
+    Un-jitted so the mesh layer can wrap it with batch-axis shardings."""
     if origins is None:
         origins = jnp.zeros((blocks.shape[0], 3), jnp.int32)
     return jax.vmap(
         lambda b, lo, hi, t, o: dog_block(b, lo, hi, t, sigma,
                                           find_max, find_min, o)
     )(blocks, min_i, max_i, threshold, origins)
+
+
+dog_block_batch = functools.partial(
+    jax.jit, static_argnames=("sigma", "find_max", "find_min")
+)(dog_block_batch_impl)
 
 
 def localize_quadratic(

@@ -107,3 +107,89 @@ def pad_batch(arrays: Sequence[np.ndarray], batch: int) -> list[np.ndarray]:
             pad = np.zeros((batch - a.shape[0],) + a.shape[1:], a.dtype)
             out.append(np.concatenate([a, pad], axis=0))
     return out
+
+
+def run_sharded_batches(
+    items: Sequence,
+    build,
+    kernel,
+    consume,
+    n_dev: int,
+    pool,
+    label: str = "batch",
+    progress: bool = False,
+    per_dev: int = 1,
+):
+    """The shared multi-device work loop: every sharded stage driver (fusion,
+    detection, nonrigid, downsample) is this pattern — the TPU replacement of
+    the reference's ``sc.parallelize(workItems).map`` (§2.4 P1/P3).
+
+    ``items`` are grouped ``n_dev`` at a time; ``build(item)`` stages one
+    item's kernel inputs on the host (a tuple of equally-shaped numpy arrays
+    within one call site's bucket); the stacked + padded batch runs through
+    ``kernel(*stacked) -> array | tuple`` (a jit with batch-axis in/out
+    shardings, one block per device); ``consume(item, *outs_i)`` handles item
+    ``i``'s slice of each output (e.g. disjoint chunk writes — no locks
+    needed, the reference's no-shuffle invariant).
+
+    Host prefetch for batch k+1 overlaps device compute for batch k (double
+    buffering); batches are resubmitted on failure via run_with_retry, and
+    completed batches are tracked so retry rounds neither re-run them nor
+    leak prefetch futures. ``per_dev`` packs that many items per device per
+    batch (compute-light kernels amortize dispatch by batching more)."""
+    from .retry import run_with_retry
+
+    group = n_dev * max(1, per_dev)
+    batches = [list(items[i:i + group]) for i in range(0, len(items), group)]
+    if not batches:
+        return
+    prefetched = {0: [pool.submit(build, it) for it in batches[0]]}
+    completed: set[int] = set()
+
+    def process_batch(bi_batch):
+        bi, batch = bi_batch
+        if bi in completed:
+            return
+        futs = prefetched.pop(bi, None)
+        if futs is None:  # retry round: prefetch again
+            futs = [pool.submit(build, it) for it in batch]
+        nxt = bi + 1
+        if nxt < len(batches) and nxt not in prefetched and nxt not in completed:
+            prefetched[nxt] = [pool.submit(build, it) for it in batches[nxt]]
+        inputs = [f.result() for f in futs]
+        stacked = pad_batch(
+            [np.stack([inp[j] for inp in inputs])
+             for j in range(len(inputs[0]))],
+            group,
+        )
+        outs = kernel(*stacked)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        outs = [np.asarray(o) for o in outs]
+        wfuts = [
+            pool.submit(consume, it, *(o[i] for o in outs))
+            for i, it in enumerate(batch)
+        ]
+        for w in wfuts:
+            w.result()
+        completed.add(bi)
+        if progress:
+            print(f"  {label}: batch {bi + 1}/{len(batches)} done")
+
+    run_with_retry(list(enumerate(batches)), process_batch, label=label)
+
+
+def shard_jit(fn, mesh: Mesh, n_in: int, n_repl: int = 0, n_out=None,
+              static_argnames=()):
+    """jit ``fn`` with the first ``n_repl`` args replicated and the remaining
+    ``n_in`` batch-leading args (and all outputs) sharded over the mesh's
+    block axis."""
+    shard = NamedSharding(mesh, P(BLOCK_AXIS))
+    repl = NamedSharding(mesh, P())
+    out_shardings = shard if n_out is None else (shard,) * n_out
+    return jax.jit(
+        fn,
+        in_shardings=(repl,) * n_repl + (shard,) * n_in,
+        out_shardings=out_shardings,
+        static_argnames=static_argnames,
+    )

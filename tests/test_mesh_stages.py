@@ -1,0 +1,169 @@
+"""Multi-device (virtual 8-CPU mesh) parity for the remaining sharded stages:
+detection, downsample, resave pyramid, and nonrigid fusion must each produce
+identical output on the 8-device mesh and on a single device (VERDICT r2 #2 —
+the TPU replacements of the Spark maps at
+SparkInterestPointDetection.java:448-660, SparkDownsample.java:141-177,
+SparkResaveN5.java:278-415, SparkNonRigidFusion.java:313-435)."""
+
+import numpy as np
+import pytest
+
+from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+from bigstitcher_spark_tpu.io.spimdata import SpimData
+
+
+@pytest.fixture(scope="module")
+def project(tmp_path_factory):
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    return make_synthetic_project(
+        str(tmp_path_factory.mktemp("mesh_stages") / "proj"),
+        n_tiles=(2, 2, 1), tile_size=(48, 48, 24), overlap=12,
+        jitter=2.0, seed=17, block_size=(16, 16, 8), n_beads_per_tile=15,
+    )
+
+
+def test_mesh_has_8_devices():
+    import jax
+
+    assert len(jax.devices()) >= 8, "conftest must provide the 8-device mesh"
+
+
+def test_detection_sharded_equals_single(project):
+    from bigstitcher_spark_tpu.models.detection import (
+        DetectionParams, detect_interest_points,
+    )
+
+    sd = SpimData.load(project.xml_path)
+    loader = ViewLoader(sd)
+    views = sd.view_ids()
+    params = DetectionParams(downsample_xy=1, downsample_z=1,
+                             block_size=(32, 32, 16))
+    multi = detect_interest_points(sd, loader, views, params, progress=False,
+                                   devices=8)
+    single = detect_interest_points(sd, loader, views, params, progress=False,
+                                    devices=1)
+    assert sum(len(d.points) for d in multi) > 0
+    for dm, ds in zip(multi, single):
+        assert dm.view == ds.view
+        np.testing.assert_array_equal(dm.points, ds.points)
+        np.testing.assert_array_equal(dm.values, ds.values)
+
+
+def _make_volume_dataset(tmp_path, name, seed):
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 60000, (48, 40, 24)).astype(np.uint16)
+    store = ChunkStore.create(str(tmp_path / f"{name}.n5"), StorageFormat.N5)
+    src = store.create_dataset("s0", data.shape, (16, 16, 8), "uint16")
+    src.write(data, (0, 0, 0))
+    return store, src, data
+
+
+def test_downsample_sharded_equals_single(tmp_path):
+    from bigstitcher_spark_tpu.models.downsample_driver import (
+        _convert_to_dtype, read_padded, run_sharded_downsample,
+    )
+    from bigstitcher_spark_tpu.utils.grid import create_grid
+
+    store, src, data = _make_volume_dataset(tmp_path, "vol", 3)
+    rel = (2, 2, 2)
+    dims = [s // f for s, f in zip(src.shape, rel)]
+    outs = {}
+    for label, n_dev in (("multi", 8), ("single", 1)):
+        dst = store.create_dataset(f"s1_{label}", dims, (16, 16, 8), "uint16")
+
+        def read_job(blk):
+            return read_padded(src.read, src.shape,
+                               [o * f for o, f in zip(blk.offset, rel)],
+                               [s * f for s, f in zip(blk.size, rel)])
+
+        def write_job(blk, out, dst=dst):
+            dst.write(_convert_to_dtype(out, dst.dtype), blk.offset)
+
+        run_sharded_downsample(create_grid(dims, (16, 16, 8)), read_job,
+                               write_job, rel, devices=n_dev)
+        outs[label] = dst.read_full()
+    # golden: plain numpy 2x2x2 average
+    ref = data.reshape(24, 2, 20, 2, 12, 2).mean(axis=(1, 3, 5))
+    ref = np.clip(np.round(ref), 0, 65535).astype(np.uint16)
+    np.testing.assert_array_equal(outs["multi"], outs["single"])
+    np.testing.assert_array_equal(outs["multi"], ref)
+
+
+def test_resave_pyramid_sharded_equals_single(project, tmp_path):
+    from bigstitcher_spark_tpu.models.resave import resave
+
+    sd = SpimData.load(project.xml_path)
+    loader = ViewLoader(sd)
+    views = sd.view_ids()
+    pyr = [[1, 1, 1], [2, 2, 2]]
+    vols = {}
+    for label, n_dev in (("multi", 8), ("single", 1)):
+        out = str(tmp_path / f"resave_{label}.n5")
+        resave(sd, loader, views, out, StorageFormat.N5,
+               block_size=(16, 16, 8), block_scale=(2, 2, 1),
+               downsamplings=pyr, devices=n_dev)
+        store = ChunkStore.open(out)
+        vols[label] = [
+            store.open_dataset(f"setup{v.setup}/timepoint{v.timepoint}/s1"
+                               ).read_full()
+            for v in views
+        ]
+    for m, s in zip(vols["multi"], vols["single"]):
+        assert m.std() > 0
+        np.testing.assert_array_equal(m, s)
+
+
+def test_nonrigid_sharded_equals_single(tmp_path):
+    from bigstitcher_spark_tpu.io.interestpoints import InterestPointStore
+    from bigstitcher_spark_tpu.models.detection import (
+        DetectionParams, detect_interest_points, save_detections,
+    )
+    from bigstitcher_spark_tpu.models.matching import (
+        MatchingParams, match_interest_points, save_matches,
+    )
+    from bigstitcher_spark_tpu.models.nonrigid_fusion import (
+        build_unique_points, fuse_nonrigid_volume,
+    )
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+    from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+    proj = make_synthetic_project(
+        str(tmp_path / "proj"), n_tiles=(2, 1, 1), tile_size=(64, 64, 32),
+        overlap=24, jitter=2.0, seed=19, n_beads_per_tile=25,
+    )
+    sd = SpimData.load(proj.xml_path)
+    views = sorted(sd.registrations)
+    loader = ViewLoader(sd)
+    dets = detect_interest_points(
+        sd, loader, views,
+        DetectionParams(downsample_xy=1, downsample_z=1,
+                        block_size=(64, 64, 32)),
+        progress=False,
+    )
+    store = InterestPointStore(str(tmp_path / "proj" / "interestpoints.n5"))
+    save_detections(sd, store, dets, DetectionParams())
+    mparams = MatchingParams(ransac_min_inliers=5, ransac_iterations=2000,
+                             model="TRANSLATION", regularization="NONE")
+    res = match_interest_points(sd, views, mparams, store, progress=False)
+    save_matches(sd, store, res, mparams, views)
+    unique = build_unique_points(sd, store, views, ["beads"])
+
+    bbox = maximal_bounding_box(sd, views, None)
+    vols = {}
+    for label, n_dev in (("multi", 8), ("single", 1)):
+        cstore = ChunkStore.create(str(tmp_path / f"nr_{label}.n5"),
+                                   StorageFormat.N5)
+        out = cstore.create_dataset("fused", bbox.shape, (32, 32, 16),
+                                    "uint16")
+        stats = fuse_nonrigid_volume(
+            sd, loader, views, unique, out, bbox,
+            block_size=(32, 32, 16), block_scale=(1, 1, 1), cpd=10.0,
+            out_dtype="uint16", min_intensity=0.0, max_intensity=65535.0,
+            devices=n_dev,
+        )
+        assert stats.voxels == bbox.num_elements
+        vols[label] = out.read_full()
+    assert vols["multi"].std() > 0
+    np.testing.assert_array_equal(vols["multi"], vols["single"])
