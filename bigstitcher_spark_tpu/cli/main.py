@@ -42,10 +42,6 @@ cli.add_command(intensity_tools.match_intensities_cmd, "match-intensities")
 cli.add_command(intensity_tools.solve_intensities_cmd, "solve-intensities")
 
 
-def register(module_names: list[str]) -> None:
-    pass
-
-
 def main():
     cli(prog_name="bst")
 

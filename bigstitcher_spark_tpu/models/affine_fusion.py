@@ -282,105 +282,165 @@ DEVICE_TILE_BUDGET_BYTES = int(
 )
 
 
-def _try_fuse_volume_device(
-    sd, loader, views, bbox, block_size, block_scale, fusion_type, blend,
-    anisotropy, out_dtype, min_intensity, max_intensity, masks, stats,
+@dataclass
+class CompositePlan:
+    """Host-side plan for the whole-volume composite fusion path: static
+    per-view windows/offsets (baked into the compiled program) plus the
+    traced per-view parameter arrays."""
+
+    plans: list
+    out_shape: tuple
+    windows: tuple
+    n_offs: tuple
+    pad: tuple
+    fracs: np.ndarray
+    img_dims: np.ndarray
+    borders: np.ndarray
+    ranges: np.ndarray
+    inside_offs: np.ndarray
+
+
+def plan_composite_volume(
+    sd, loader, views, bbox, anisotropy, blend, masks=False,
     mask_offset=(0.0, 0.0, 0.0),
-):
-    """Whole-volume device-resident fusion (one dispatch, tiles live in HBM).
-
-    Applies when every view is translation-registered at a single level and
-    the tile stack fits the device budget; returns the fused (unpadded)
-    volume as numpy, or None to fall back to the per-block path."""
-    import jax
-    import jax.numpy as jnp
-
-    compute_block = tuple(b * s for b, s in zip(block_size, block_scale))
-    grid = create_grid(bbox.shape, compute_block, block_size)
-    all_plans: list[list[_ViewPlan]] = []
-    view_order: dict[ViewId, int] = {}
-    for block in grid:
-        block_global = Interval.from_shape(
-            compute_block, block.offset).translate(bbox.min)
-        plans = plan_block(sd, loader, views, block_global, anisotropy)
-        if any(not p.is_translation for p in plans):
-            return None
-        for p in plans:
-            view_order.setdefault(p.view, len(view_order))
-        all_plans.append(plans)
-    if not view_order:
+) -> CompositePlan | None:
+    """Plan the composite device path. None when a view is not a pure
+    translation at stored level 0 or the tile stack exceeds the budget."""
+    vol_iv = Interval.from_shape(bbox.shape).translate(bbox.min)
+    plans = plan_block(sd, loader, views, vol_iv, anisotropy)
+    if not plans:
         return None
-    # uniform padded tile shape; must hold the slice window (block+1)
-    shapes = [loader.open(v, 0).shape for v in view_order]
-    levels = {p.level for plans in all_plans for p in plans}
-    if levels - {0}:
+    if any(not p.is_translation or p.level != 0 for p in plans):
         return None
-    tile_shape = tuple(
-        max(max(s[d] for s in shapes), compute_block[d] + 1) for d in range(3)
-    )
-    nbytes = len(view_order) * int(np.prod(tile_shape)) * 2
+    shapes = [tuple(int(s) for s in p.img_dim) for p in plans]
+    itemsizes = [np.dtype(loader.open(p.view, 0).dtype).itemsize
+                 for p in plans]
+    nbytes = sum(int(np.prod(s)) * isz for s, isz in zip(shapes, itemsizes))
     if nbytes > DEVICE_TILE_BUDGET_BYTES:
         return None
 
-    with profiling.span("fusion.h2d_tiles"):
-        tiles_np = np.zeros((len(view_order), *tile_shape), dtype=np.uint16)
-        for v, i in view_order.items():
-            img = loader.open(v, 0).read_full()
-            if img.dtype != np.uint16:
-                return None  # uint16 staging only; others use per-block path
-            tiles_np[i, : img.shape[0], : img.shape[1], : img.shape[2]] = img
-        tiles = jax.device_put(tiles_np)
-
-    B = len(grid)
-    K = F.bucket_views(max((len(p) for p in all_plans), default=1))
-    view_idx = np.zeros((B, K), np.int32)
-    floor_offs = np.zeros((B, K, 3), np.int32)
-    fracs = np.zeros((B, K, 3), np.float32)
-    lpos0 = np.zeros((B, K, 3), np.float32)
-    img_dims = np.ones((B, K, 3), np.float32)
-    borders = np.zeros((B, K, 3), np.float32)
-    ranges = np.ones((B, K, 3), np.float32)
-    valid = np.zeros((B, K), np.float32)
-    inside_offs = np.zeros((B, K, 3), np.float32)
+    out_shape = tuple(bbox.shape)
+    io_ceil = tuple(int(np.ceil(max(0.0, o))) for o in
+                    (mask_offset if masks else (0.0, 0.0, 0.0)))
+    # tile pad must cover the window widening from --maskOffset inside-test
+    # expansion, or the static corner slices run out of bounds
+    pad = tuple(1 + io_ceil[d] for d in range(3))
+    windows, n_offs = [], []
+    fracs = np.zeros((len(plans), 3), np.float32)
+    img_dims = np.ones((len(plans), 3), np.float32)
+    borders = np.zeros((len(plans), 3), np.float32)
+    ranges = np.ones((len(plans), 3), np.float32)
+    inside_offs = np.zeros((len(plans), 3), np.float32)
     if masks:
         inside_offs[:] = np.asarray(mask_offset, np.float32)
-    block_offsets = np.zeros((B, 3), np.int32)
-    for bi, (block, plans) in enumerate(zip(grid, all_plans)):
-        block_offsets[bi] = block.offset
-        bg_min = np.asarray(block.offset, np.float64) + np.asarray(bbox.min)
-        for ki, p in enumerate(plans):
-            tlevel = p.inv_total[:, :3] @ bg_min + p.inv_total[:, 3]
-            fo = np.floor(tlevel).astype(np.int64)
-            view_idx[bi, ki] = view_order[p.view]
-            floor_offs[bi, ki] = fo
-            fracs[bi, ki] = tlevel - fo
-            lpos0[bi, ki] = tlevel
-            img_dims[bi, ki] = p.img_dim
-            factors = loader.downsampling_factors(p.view.setup)[p.level]
-            borders[bi, ki] = np.asarray(blend.border) / np.asarray(factors)
-            ranges[bi, ki] = np.asarray(blend.range) / np.asarray(factors)
-            valid[bi, ki] = 1.0
+    bb_min = np.asarray(bbox.min, np.float64)
+    for i, p in enumerate(plans):
+        # tile coord of output voxel (0,0,0): g = inv_total @ bbox.min
+        g = p.inv_total[:, :3] @ bb_min + p.inv_total[:, 3]
+        n = np.floor(g).astype(np.int64)
+        f = g - n
+        S = shapes[i]
+        a = tuple(int(max(0, -n[d] - 1 - io_ceil[d])) for d in range(3))
+        b = tuple(int(min(out_shape[d], S[d] - n[d] + io_ceil[d]))
+                  for d in range(3))
+        windows.append((a, b))
+        n_offs.append(tuple(int(v) for v in n))
+        fracs[i] = f
+        img_dims[i] = p.img_dim
+        factors = loader.downsampling_factors(p.view.setup)[p.level]
+        borders[i] = np.asarray(blend.border) / np.asarray(factors)
+        ranges[i] = np.asarray(blend.range) / np.asarray(factors)
+    return CompositePlan(plans, out_shape, tuple(windows), tuple(n_offs),
+                         pad, fracs, img_dims, borders, ranges, inside_offs)
 
-    padded = tuple(
-        int(np.ceil(bbox.shape[d] / compute_block[d]) * compute_block[d])
-        for d in range(3)
-    )
+
+def upload_composite_tiles(loader, cp: CompositePlan) -> list:
+    """Stage the plan's tiles in HBM (async device_put per tile)."""
+    import jax
+
+    with profiling.span("fusion.h2d_tiles"):
+        return [jax.device_put(loader.open(p.view, 0).read_full())
+                for p in cp.plans]
+
+
+def dispatch_composite(cp: CompositePlan, tiles, fusion_type, out_dtype,
+                       masks, min_intensity, max_intensity):
+    """Run the compiled composite program; returns the device-resident
+    converted output (does not block)."""
+    fuser = F.make_translation_composite(
+        cp.out_shape, cp.windows, cp.n_offs, pad=cp.pad,
+        fusion_type=fusion_type, out_dtype=out_dtype, masks=masks)
+    return fuser(tiles, cp.fracs, cp.img_dims, cp.borders, cp.ranges,
+                 cp.inside_offs, np.float32(min_intensity),
+                 np.float32(max_intensity))
+
+
+def _try_fuse_volume_device(
+    sd, loader, views, bbox, fusion_type, blend,
+    anisotropy, out_dtype, min_intensity, max_intensity, masks, stats,
+    mask_offset=(0.0, 0.0, 0.0),
+):
+    """Whole-volume device-resident fusion via the static composite kernel
+    (ops.fusion.make_translation_composite): per-view static output windows,
+    8 statically-shifted slices, separable blend — no dynamic slices, so the
+    XLA program is pure fused elementwise work at HBM speed.
+
+    Applies when every view is translation-registered at stored level 0 and
+    the tile stack fits the device budget; returns the fused volume as a
+    DEVICE array (converted to out_dtype) ready for pipelined D2H via
+    _drain_device_volume, or None to fall back to the per-block path."""
+    cp = plan_composite_volume(sd, loader, views, bbox, anisotropy, blend,
+                               masks, mask_offset)
+    if cp is None:
+        return None
+    tiles = upload_composite_tiles(loader, cp)
     if stats is not None:
-        stats.compile_keys.add((padded, compute_block, K, fusion_type, "scan"))
+        stats.compile_keys.add((cp.out_shape, cp.windows, fusion_type,
+                                out_dtype, masks, "composite"))
     with profiling.span("fusion.kernel"):
-        out = F.fuse_volume_scan(
-            tiles, view_idx, floor_offs, fracs, lpos0, img_dims, borders,
-            ranges, valid, block_offsets,
-            jnp.float32(min_intensity), jnp.float32(max_intensity),
-            out_shape=padded, block_shape=compute_block,
-            fusion_type=fusion_type, out_dtype=out_dtype, masks=masks,
-            inside_offs=inside_offs,
-        )
-        with profiling.span("fusion.d2h"):
-            out = np.asarray(out)
-    sl = tuple(slice(0, s) for s in bbox.shape)
-    return out[sl]
+        out = dispatch_composite(cp, tiles, fusion_type, out_dtype, masks,
+                                 min_intensity, max_intensity)
+        out.block_until_ready()
+    return out
 
+
+def _drain_device_volume(out, out_ds, zarr_ct, io_threads=4):
+    """Pipelined D2H + write of a device-resident fused volume: slab along x
+    in storage-chunk multiples (each slab write touches its chunks exactly
+    once), start all transfers asynchronously, and let a thread pool overlap
+    the remaining transfers with compression + disk writes."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    bs = out_ds.block_size
+    step = max(int(bs[0]), 1)
+    # target ~8-16 MB per slab for best tunnel throughput
+    target = 12 << 20
+    row_bytes = int(np.prod(out.shape[1:])) * out.dtype.itemsize
+    if row_bytes * step < target:
+        step = int(np.ceil(target / max(row_bytes * step, 1))) * step
+    slabs = []
+    for x0 in range(0, out.shape[0], step):
+        x1 = min(x0 + step, out.shape[0])
+        slabs.append((x0, out[x0:x1]))
+    for _, s in slabs:
+        try:
+            s.copy_to_host_async()
+        except AttributeError:
+            pass
+
+    def drain(item):
+        x0, slab = item
+        with profiling.span("fusion.d2h"):
+            data = np.asarray(slab)
+        with profiling.span("fusion.write"):
+            if zarr_ct is not None:
+                c, t = zarr_ct
+                out_ds.write(data[..., None, None], (x0, 0, 0, c, t))
+            else:
+                out_ds.write(data, (x0, 0, 0))
+
+    with ThreadPoolExecutor(max_workers=max(1, io_threads)) as pool:
+        list(pool.map(drain, slabs))
 
 def _write_block(out_ds, data, block, zarr_ct):
     with profiling.span("fusion.write"):
@@ -543,20 +603,15 @@ def fuse_volume(
         stats.seconds = time.time() - t0
         return stats
 
-    use_scan = device_resident is not False
-    vol = None if (coefficients is not None or not use_scan) else (
+    use_composite = device_resident is not False
+    vol = None if (coefficients is not None or not use_composite) else (
         _try_fuse_volume_device(
-            sd, loader, views, bbox, block_size, block_scale, fusion_type,
+            sd, loader, views, bbox, fusion_type,
             blend or BlendParams(), aniso, out_dtype, min_intensity,
             max_intensity, masks, stats, mask_offset=mask_offset,
         ))
     if vol is not None:
-        with profiling.span("fusion.write"):
-            if zarr_ct is not None:
-                c, t = zarr_ct
-                out_ds.write(vol[..., None, None], (0, 0, 0, c, t))
-            else:
-                out_ds.write(vol, (0, 0, 0))
+        _drain_device_volume(vol, out_ds, zarr_ct, io_threads=io_threads)
         stats.blocks = len(grid)
         stats.voxels = bbox.num_elements
         stats.seconds = time.time() - t0

@@ -223,11 +223,21 @@ def plan_group_pairs(
             if params.overlap_filter and not boxes[i].overlaps(boxes[j]):
                 continue
             out.append((groups[i], groups[j]))
+    if (not out and len(groups) > 1 and params.split_timepoints
+            and policy == INDIVIDUAL_TIMEPOINTS):
+        import warnings
+
+        warnings.warn(
+            "--splitTimepoints merges each timepoint into one group, and the "
+            "default TIMEPOINTS_INDIVIDUALLY policy only pairs groups within "
+            "a timepoint — no pairs to match. Use -rtp ALL_TO_ALL(_RANGE) or "
+            "REFERENCE_TIMEPOINT with --splitTimepoints.",
+            stacklevel=2)
     return out
 
 
 def merge_min_distance(
-    view_of: np.ndarray, ids: np.ndarray, world: np.ndarray, radius: float
+    view_of: np.ndarray, world: np.ndarray, radius: float
 ) -> np.ndarray:
     """Keep-mask for pooled group points: a point is dropped when a point of
     an EARLIER member view lies within ``radius`` (the near-duplicate beads
@@ -285,7 +295,7 @@ def _match_grouped(
         view_of = np.concatenate(view_of) if view_of else np.zeros(0, np.int32)
         ids = np.concatenate(ids) if ids else np.zeros(0, np.uint64)
         pts = (np.concatenate(pts) if pts else np.zeros((0, 3), np.float64))
-        keep = merge_min_distance(view_of, ids, pts, params.merge_distance)
+        keep = merge_min_distance(view_of, pts, params.merge_distance)
         return view_of[keep], ids[keep], pts[keep]
 
     min_matches = M.MIN_POINTS[params.model]

@@ -357,6 +357,128 @@ fuse_volume_scan = jax.jit(
 )
 
 
+# ---------------------------------------------------------------------------
+# Static composite translation fusion: the whole-volume device path, redesigned.
+#
+# The lax.scan device path (above) walks the block grid with dynamic slices —
+# on TPU those force relayouts of unaligned windows and run two orders of
+# magnitude below HBM speed. For translation-registered views the right XLA
+# program has NO dynamic control flow at all: each view's tile occupies a
+# statically-known output window (floor of its world offset), its sub-pixel
+# fraction is a constant trilinear mix of EIGHT STATICALLY-SHIFTED tile
+# slices, and its blend weight is a separable outer product of 1-D vectors.
+# So the volume fuse compiles to a handful of pads, slices, and fused
+# elementwise ops — pure bandwidth. One compile per (volume layout) key,
+# cached; offsets are baked in as constants.
+# ---------------------------------------------------------------------------
+
+
+def _composite_one_view(P, frac, img_dim, border, blend_range, inside_off,
+                        a, L, n, pad):
+    """One view's contribution over its static output window.
+
+    ``P``: tile padded by ``pad`` voxels on every side (so the 8 corner
+    slices are always in-bounds, including windows widened by --maskOffset).
+    ``a``/``L``/``n``: static window start, window length, and integer tile
+    offset. Returns (val, inside, blend) of shape L."""
+    fx, fy, fz = frac[0], frac[1], frac[2]
+    val = jnp.zeros(L, jnp.float32)
+    for cx in (0, 1):
+        wxc = fx if cx else 1.0 - fx
+        for cy in (0, 1):
+            wyc = fy if cy else 1.0 - fy
+            for cz in (0, 1):
+                wzc = fz if cz else 1.0 - fz
+                start = (a[0] + n[0] + pad[0] + cx, a[1] + n[1] + pad[1] + cy,
+                         a[2] + n[2] + pad[2] + cz)
+                sl = jax.lax.slice(
+                    P, start, tuple(start[d] + L[d] for d in range(3)))
+                val = val + (wxc * wyc * wzc) * sl
+    ws, ins = [], []
+    for d in range(3):
+        pos = (a[d] + n[d]) + jnp.arange(L[d], dtype=jnp.float32) + frac[d]
+        lo = border[d]
+        hi = img_dim[d] - 1.0 - border[d]
+        dd = jnp.minimum(pos - lo, hi - pos)
+        r = jnp.maximum(blend_range[d], 1e-6)
+        ramp = 0.5 * (jnp.cos((1.0 - dd / r) * jnp.pi) + 1.0)
+        ws.append(jnp.where(dd < 0, 0.0, jnp.where(dd < r, ramp, 1.0)))
+        ins.append(((pos >= -inside_off[d])
+                    & (pos <= img_dim[d] - 1.0 + inside_off[d])
+                    ).astype(jnp.float32))
+    blend = ws[0][:, None, None] * ws[1][None, :, None] * ws[2][None, None, :]
+    inside = ins[0][:, None, None] * ins[1][None, :, None] * ins[2][None, None, :]
+    return val, inside, blend
+
+
+@functools.lru_cache(maxsize=32)
+def make_translation_composite(
+    out_shape: tuple[int, int, int],
+    windows: tuple,      # per-view ((a0,a1,a2), (b0,b1,b2)) static ints
+    n_offs: tuple,       # per-view (3,) static int tile offsets (floor)
+    pad: tuple = (1, 1, 1),  # per-axis tile pad (1 + ceil(maskOffset))
+    fusion_type: str = "AVG_BLEND",
+    out_dtype: str = "float32",
+    masks: bool = False,
+):
+    """Build + jit the composite fusion program for one volume layout.
+
+    Returned fn(tiles, fracs, img_dims, borders, ranges, inside_offs,
+    min_i, max_i) -> converted output of ``out_shape``. ``tiles`` is a list
+    of raw (unpadded) per-view tiles (any integer/float dtype)."""
+    V = len(windows)
+
+    def impl(tiles, fracs, img_dims, borders, ranges, inside_offs, min_i, max_i):
+        if fusion_type == "MAX_INTENSITY":
+            acc = jnp.full(out_shape, -jnp.inf, jnp.float32)
+        else:
+            acc = jnp.zeros(out_shape, jnp.float32)
+        wsum = jnp.zeros(out_shape, jnp.float32)
+        order = range(V - 1, -1, -1) if fusion_type == "FIRST_WINS" else range(V)
+        for v in order:
+            (a, b), n = windows[v], n_offs[v]
+            L = tuple(b[d] - a[d] for d in range(3))
+            if any(s <= 0 for s in L):
+                continue
+            P = jnp.pad(tiles[v].astype(jnp.float32),
+                        tuple((p, p) for p in pad))
+            val, inside, blend = _composite_one_view(
+                P, fracs[v], img_dims[v], borders[v], ranges[v],
+                inside_offs[v], a, L, n, pad)
+            win = tuple(slice(a[d], b[d]) for d in range(3))
+            if fusion_type == "AVG":
+                w = inside
+            elif fusion_type == "AVG_BLEND":
+                w = inside * blend
+            elif fusion_type == "MAX_INTENSITY":
+                region = acc[win]
+                acc = acc.at[win].set(
+                    jnp.maximum(region, jnp.where(inside > 0, val, -jnp.inf)))
+                wsum = wsum.at[win].add(inside)
+                continue
+            elif fusion_type in ("FIRST_WINS", "LAST_WINS"):
+                region = acc[win]
+                acc = acc.at[win].set(jnp.where(inside > 0, val, region))
+                wsum = wsum.at[win].add(inside)
+                continue
+            else:
+                raise ValueError(f"unknown fusion type {fusion_type}")
+            acc = acc.at[win].add(val * w)
+            wsum = wsum.at[win].add(w)
+        if fusion_type in ("MAX_INTENSITY", "FIRST_WINS", "LAST_WINS"):
+            fused = jnp.where(wsum > 0, acc, 0.0)
+        else:
+            fused = jnp.where(wsum > 0, acc / jnp.maximum(wsum, 1e-20), 0.0)
+        if masks:
+            info_max = (1.0 if out_dtype == "float32"
+                        else float(np.iinfo(np.dtype(out_dtype)).max))
+            return ((wsum > 0).astype(jnp.float32) * info_max).astype(
+                np.dtype(out_dtype))
+        return _convert_intensity_expr(fused, min_i, max_i, out_dtype)
+
+    return jax.jit(impl)
+
+
 def _convert_intensity_expr(block, min_i, max_i, out_dtype: str):
     """Map [min,max] -> full integer range (uint8/uint16) or pass float through
     (reference type converters, SparkAffineFusion.java:497-517)."""

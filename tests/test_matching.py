@@ -224,11 +224,10 @@ class TestGroupedMatching:
             [50.1, 0.0, 0.1], [0.1, 0.0, 0.1],      # view 2: dups of p1, p0
         ])
         view_of = np.array([0, 0, 1, 1, 2, 2])
-        ids = np.arange(6, dtype=np.uint64)
-        keep = merge_min_distance(view_of, ids, pts, radius=5.0)
+        keep = merge_min_distance(view_of, pts, radius=5.0)
         assert keep.tolist() == [True, True, False, True, False, False]
         # radius 0 disables merging
-        assert merge_min_distance(view_of, ids, pts, radius=0.0).all()
+        assert merge_min_distance(view_of, pts, radius=0.0).all()
 
     @pytest.fixture(scope="class")
     def two_channel_project(self, tmp_path_factory):
@@ -304,6 +303,29 @@ class TestGroupedMatching:
             assert np.median(d) < 1.5
         save_matches(sd, store, results, params,  views)
 
+    def test_split_timepoints_individual_policy_warns(self, two_channel_project):
+        """--splitTimepoints + the default TIMEPOINTS_INDIVIDUALLY policy
+        yields zero pairs; plan_group_pairs must say so instead of silently
+        matching nothing (ADVICE r2 low, VERDICT r3 item 9)."""
+        import warnings
+
+        from bigstitcher_spark_tpu.models.matching import (
+            MatchingParams, build_match_groups, plan_group_pairs,
+        )
+
+        proj, sd, store, views = two_channel_project
+        # fake a second timepoint so there are two per-timepoint groups
+        params = MatchingParams(split_timepoints=True)
+        groups = build_match_groups(sd, views, params)
+        groups = [groups[0], tuple(
+            type(v)(timepoint=v.timepoint + 1, setup=v.setup)
+            for v in groups[0])]
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            pairs = plan_group_pairs(sd, groups, params)
+        assert pairs == []
+        assert any("splitTimepoints" in str(x.message) for x in w)
+
     def test_merge_distance_drops_cross_view_duplicates(
             self, two_channel_project):
         """Points duplicated across a group's member views within the merge
@@ -329,8 +351,7 @@ class TestGroupedMatching:
         pts.append(pts[0] + 0.3)
         view_of = np.concatenate(view_of)
         pts = np.concatenate(pts)
-        keep = merge_min_distance(
-            view_of, np.arange(len(pts), dtype=np.uint64), pts, 5.0)
+        keep = merge_min_distance(view_of, pts, 5.0)
         n0 = int((view_of == 0).sum())
         # all injected duplicates dropped, non-duplicate points kept
         assert keep.sum() == len(pts) - n0

@@ -68,12 +68,28 @@ def test_sharded_masks_mode(project, tmp_path):
     assert (multi == single).all()
 
 
-def test_sharded_device_scan_agrees(project, tmp_path):
-    """The single-device whole-volume scan path and the sharded per-block
-    path agree (same math, different dispatch)."""
+def test_composite_masks_with_mask_offset(project, tmp_path):
+    """--maskOffset widens the inside test beyond the tile; the composite
+    kernel's static slices must stay in bounds (pad = 1 + ceil(offset))
+    and agree with the per-block path."""
+    multi, _ = _fuse(project, tmp_path, "mo_pb", devices=1,
+                     device_resident=False, masks=True,
+                     mask_offset=(2.0, 2.0, 2.0))
+    comp, st = _fuse(project, tmp_path, "mo_comp", devices=1, masks=True,
+                     mask_offset=(2.0, 2.0, 2.0))
+    assert any("composite" in str(k) for k in st.compile_keys)
+    assert (comp == multi).all()
+    # offset=2 must strictly grow coverage vs offset=0
+    plain, _ = _fuse(project, tmp_path, "mo_plain", devices=1, masks=True)
+    assert (comp > 0).sum() >= (plain > 0).sum()
+
+
+def test_sharded_device_composite_agrees(project, tmp_path):
+    """The single-device whole-volume composite path and the sharded
+    per-block path agree (same math, different dispatch)."""
     multi, _ = _fuse(project, tmp_path, "multi_s", devices=8)
     scan, st = _fuse(project, tmp_path, "scan", devices=1)
-    assert any("scan" in str(k) for k in st.compile_keys), \
-        "single-device run did not take the device-resident scan path"
+    assert any("composite" in str(k) for k in st.compile_keys), \
+        "single-device run did not take the device-resident composite path"
     diff = np.abs(multi.astype(np.int64) - scan.astype(np.int64))
     assert diff.max() <= 1  # rounding at f32 accumulation order boundaries
