@@ -10,18 +10,42 @@ import click
 from ..io.spimdata import SpimData
 
 
+def _set_s3_region(ctx, param, value):
+    if value:
+        from ..io.uris import set_s3_region
+
+        set_s3_region(value)
+    return value
+
+
 def infrastructure_options(f):
-    """--dryRun etc. (AbstractInfrastructure.java:14-27)."""
+    """--dryRun / --s3Region (AbstractInfrastructure.java:14-27)."""
     f = click.option("--dryRun", "dry_run", is_flag=True, default=False,
                      help="compute but do not persist results")(f)
+    f = click.option("--s3Region", "s3_region", default=None,
+                     expose_value=False, callback=_set_s3_region,
+                     help="AWS region for s3:// storage roots")(f)
     return f
 
 
+def _xml_path_ok(ctx, param, value):
+    from ..io.uris import has_scheme, strip_file_scheme
+
+    if value is not None and not has_scheme(value):
+        import os
+
+        value = strip_file_scheme(value)
+        if not os.path.exists(value):
+            raise click.BadParameter(f"XML not found: {value}")
+    return value
+
+
 def xml_option(f):
-    """-x/--xml (AbstractBasic.java:43-70)."""
+    """-x/--xml; accepts local paths and s3://, gs://, memory:// URIs
+    (AbstractBasic.java:43-70 + URITools)."""
     return click.option("-x", "--xml", "xml", required=True,
-                        type=click.Path(exists=True),
-                        help="path to the SpimData XML project")(f)
+                        callback=_xml_path_ok,
+                        help="path or URI of the SpimData XML project")(f)
 
 
 def view_selection_options(f):

@@ -15,6 +15,7 @@ import numpy as np
 
 from ..io.chunkstore import ChunkStore, StorageFormat
 from ..io.container import (
+    open_container,
     create_fusion_container,
     estimate_multires_pyramid,
     read_container_meta,
@@ -23,6 +24,7 @@ from ..io.dataset_io import ViewLoader
 from ..io.spimdata import SpimData, ViewId
 from ..models.affine_fusion import BlendParams, fuse_volume
 from ..ops.fusion import FUSION_TYPES
+from ..io.uris import has_scheme
 from ..utils.geometry import Interval
 from ..utils.viewselect import (
     anisotropy_factor_from_voxel_sizes,
@@ -35,6 +37,12 @@ from .common import (
     view_selection_options,
     xml_option,
 )
+
+
+def _abs_if_local(path: str) -> str:
+    """abspath local paths; cloud URIs pass through untouched."""
+    return path if has_scheme(path) else os.path.abspath(path)
+
 
 _DTYPES = ("UINT8", "UINT16", "FLOAT32")
 
@@ -121,7 +129,7 @@ def create_fusion_container_cmd(xml, output, storage, data_type, block_size,
         return
 
     meta = create_fusion_container(
-        output, storage_format, os.path.abspath(xml),
+        output, storage_format, _abs_if_local(xml),
         num_timepoints, num_channels, bbox,
         data_type=data_type.lower(), block_size=bs, downsamplings=ds,
         compression=compression, bdv=bdv,
@@ -143,8 +151,9 @@ def _write_bdv_output_xml(xml_out: str, container: str, meta, storage_format) ->
     from ..utils.geometry import identity_affine
 
     out = SpimData()
-    fmt = "bdv.n5" if storage_format == StorageFormat.N5 else "bdv.zarr"
-    out.image_loader = ImageLoader(format=fmt, path=os.path.abspath(container),
+    fmt = {StorageFormat.N5: "bdv.n5", StorageFormat.ZARR: "bdv.zarr",
+           StorageFormat.HDF5: "bdv.hdf5"}[storage_format]
+    out.image_loader = ImageLoader(format=fmt, path=_abs_if_local(container),
                                   path_type="absolute")
     out.timepoints = list(range(meta.num_timepoints))
     dims = meta.bbox.shape
@@ -192,7 +201,7 @@ def affine_fusion_cmd(output, fusion_type, block_scale, masks, mask_offset,
                       timepoint_index, intensity_n5, dry_run, **kwargs):
     """Fuse all views into the prepared container (THE workload)."""
     t_start = time.time()
-    store = ChunkStore.open(output)
+    store = open_container(output)
     try:
         meta = read_container_meta(store)
     except ValueError as e:
@@ -318,7 +327,7 @@ def nonrigid_fusion_cmd(output, labels, cpd, alpha, fusion_type, block_scale,
     )
 
     t_start = time.time()
-    store = ChunkStore.open(output)
+    store = open_container(output)
     try:
         meta = read_container_meta(store)
     except ValueError as e:

@@ -25,6 +25,19 @@ from typing import Any, Sequence
 import numpy as np
 import tensorstore as ts
 
+from . import uris
+
+# one shared Context so every open in this process sees the same caches and
+# the same in-process ``memory://`` store (tensorstore scopes the memory
+# kvstore to a Context; without sharing, each open would get an empty store)
+_TS_CONTEXT: list = [None]
+
+
+def ts_context():
+    if _TS_CONTEXT[0] is None:
+        _TS_CONTEXT[0] = ts.Context()
+    return _TS_CONTEXT[0]
+
 
 class StorageFormat(str, enum.Enum):
     N5 = "N5"
@@ -132,6 +145,7 @@ class Dataset:
         Returns False when ineligible (caller falls back to tensorstore)."""
         if (self.reversed_axes or self.store is None
                 or getattr(self.store, "format", None) != StorageFormat.N5
+                or not getattr(self.store, "is_local", False)
                 or os.environ.get("BST_NATIVE_IO", "1") != "1"):
             return False
         comp = (self.store.get_attribute(self.path, "compression", {}) or {})
@@ -178,13 +192,47 @@ class Dataset:
 
 
 class ChunkStore:
-    """A root N5/ZARR container on a local filesystem path."""
+    """A root N5/ZARR container on a local path or cloud URI.
+
+    Roots may be plain paths or ``s3://bucket/…``, ``gs://bucket/…``,
+    ``memory://…`` URIs (the reference's URITools/N5Util URI routing,
+    util/N5Util.java:47-80); tensorstore kvstore drivers do the transport."""
 
     def __init__(self, root: str | os.PathLike, fmt: StorageFormat):
-        self.root = str(root)
+        self.is_local = not uris.has_scheme(root)
+        self.root = uris.strip_file_scheme(root) if self.is_local else str(root)
         self.format = StorageFormat(fmt)
         if self.format == StorageFormat.HDF5:
             raise ValueError("use Hdf5Store for HDF5")
+        self._kv = None
+
+    def _kvstore(self):
+        """Root-level tensorstore KvStore (non-local roots)."""
+        if self._kv is None:
+            self._kv = ts.KvStore.open(
+                uris.kvstore_spec(self.root), context=ts_context()).result()
+        return self._kv
+
+    # -- raw object IO (attribute files, markers) --------------------------
+
+    def _read_obj(self, rel: str) -> bytes | None:
+        if self.is_local:
+            p = os.path.join(self.root, rel)
+            if not os.path.exists(p):
+                return None
+            with open(p, "rb") as f:
+                return f.read()
+        r = self._kvstore().read(rel).result()
+        return bytes(r.value) if r.state == "value" else None
+
+    def _write_obj(self, rel: str, data: bytes) -> None:
+        if self.is_local:
+            p = os.path.join(self.root, rel)
+            os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+            with open(p, "wb") as f:
+                f.write(data)
+            return
+        self._kvstore().write(rel, data).result()
 
     # -- creation ----------------------------------------------------------
 
@@ -192,50 +240,45 @@ class ChunkStore:
     def create(root: str | os.PathLike, fmt: StorageFormat) -> "ChunkStore":
         fmt = StorageFormat(fmt)
         store = ChunkStore(root, fmt)
-        os.makedirs(store.root, exist_ok=True)
+        if store.is_local:
+            os.makedirs(store.root, exist_ok=True)
         if fmt == StorageFormat.N5:
-            store._merge_json(store._attr_file(""), {"n5": "2.5.1"})
+            store._merge_json("attributes.json", {"n5": "2.5.1"})
         else:
-            store._merge_json(os.path.join(store.root, ".zgroup"), {"zarr_format": 2})
+            store._merge_json(".zgroup", {"zarr_format": 2})
         return store
 
     @staticmethod
     def open(root: str | os.PathLike) -> "ChunkStore":
         root = str(root)
-        if os.path.exists(os.path.join(root, "attributes.json")):
-            return ChunkStore(root, StorageFormat.N5)
-        if os.path.exists(os.path.join(root, ".zgroup")) or os.path.exists(
-            os.path.join(root, ".zattrs")
-        ):
+        probe = ChunkStore(root, StorageFormat.N5)
+        if probe._read_obj("attributes.json") is not None:
+            return probe
+        if (probe._read_obj(".zgroup") is not None
+                or probe._read_obj(".zattrs") is not None):
             return ChunkStore(root, StorageFormat.ZARR)
         # guess by extension
         if root.rstrip("/").endswith((".zarr", ".ome.zarr")):
             return ChunkStore(root, StorageFormat.ZARR)
-        return ChunkStore(root, StorageFormat.N5)
+        return probe
 
     # -- attributes --------------------------------------------------------
 
-    def _attr_file(self, group: str) -> str:
+    def _attr_rel(self, group: str) -> str:
         name = "attributes.json" if self.format == StorageFormat.N5 else ".zattrs"
-        return os.path.join(self.root, group.strip("/"), name)
+        g = group.strip("/")
+        return f"{g}/{name}" if g else name
 
-    @staticmethod
-    def _merge_json(path: str, updates: dict) -> None:
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        current: dict = {}
-        if os.path.exists(path):
-            with open(path) as f:
-                current = json.load(f)
+    def _merge_json(self, rel: str, updates: dict) -> None:
+        raw = self._read_obj(rel)
+        current: dict = json.loads(raw) if raw else {}
         current.update(updates)
-        with open(path, "w") as f:
-            json.dump(current, f, indent=0, default=_json_default)
+        self._write_obj(rel, json.dumps(
+            current, indent=0, default=_json_default).encode())
 
     def get_attributes(self, group: str = "") -> dict:
-        path = self._attr_file(group)
-        if not os.path.exists(path):
-            return {}
-        with open(path) as f:
-            return json.load(f)
+        raw = self._read_obj(self._attr_rel(group))
+        return json.loads(raw) if raw else {}
 
     def set_attribute(self, group: str, key_path: str, value: Any) -> None:
         """N5-style nested attribute: key path split on '/'."""
@@ -245,10 +288,8 @@ class ChunkStore:
         for k in keys[:-1]:
             node = node.setdefault(k, {})
         node[keys[-1]] = value
-        path = self._attr_file(group)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(attrs, f, indent=0, default=_json_default)
+        self._write_obj(self._attr_rel(group), json.dumps(
+            attrs, indent=0, default=_json_default).encode())
 
     def get_attribute(self, group: str, key_path: str, default: Any = None) -> Any:
         node: Any = self.get_attributes(group)
@@ -261,7 +302,11 @@ class ChunkStore:
     # -- datasets ----------------------------------------------------------
 
     def _kvpath(self, path: str) -> str:
+        """Local filesystem path of a sub-path (local roots only)."""
         return os.path.join(self.root, path.strip("/"))
+
+    def _dataset_kvstore(self, path: str) -> dict:
+        return uris.kvstore_spec(self.root, path.strip("/"))
 
     def create_dataset(
         self,
@@ -282,7 +327,7 @@ class ChunkStore:
         if self.format == StorageFormat.N5:
             spec = {
                 "driver": "n5",
-                "kvstore": {"driver": "file", "path": self._kvpath(path)},
+                "kvstore": self._dataset_kvstore(path),
                 "metadata": {
                     "dimensions": list(shape),
                     "blockSize": list(block),
@@ -292,7 +337,7 @@ class ChunkStore:
                 "create": True,
                 "delete_existing": delete_existing,
             }
-            arr = ts.open(spec).result()
+            arr = ts.open(spec, context=ts_context()).result()
             return Dataset(self, path, arr, reversed_axes=False)
         else:
             meta: dict[str, Any] = {
@@ -303,63 +348,91 @@ class ChunkStore:
             }
             spec = {
                 "driver": "zarr",
-                "kvstore": {"driver": "file", "path": self._kvpath(path)},
+                "kvstore": self._dataset_kvstore(path),
                 "metadata": meta,
                 "create": True,
                 "delete_existing": delete_existing,
             }
-            arr = ts.open(spec).result()
+            arr = ts.open(spec, context=ts_context()).result()
             return Dataset(self, path, arr, reversed_axes=True)
 
     def open_dataset(self, path: str) -> Dataset:
         if self.format == StorageFormat.N5:
             spec = {
                 "driver": "n5",
-                "kvstore": {"driver": "file", "path": self._kvpath(path)},
+                "kvstore": self._dataset_kvstore(path),
                 "open": True,
             }
-            return Dataset(self, path, ts.open(spec).result(), reversed_axes=False)
+            return Dataset(self, path, ts.open(spec, context=ts_context()).result(),
+                           reversed_axes=False)
         spec = {
             "driver": "zarr",
-            "kvstore": {"driver": "file", "path": self._kvpath(path)},
+            "kvstore": self._dataset_kvstore(path),
             "open": True,
         }
-        return Dataset(self, path, ts.open(spec).result(), reversed_axes=True)
+        return Dataset(self, path, ts.open(spec, context=ts_context()).result(),
+                       reversed_axes=True)
 
     def is_dataset(self, path: str) -> bool:
-        p = self._kvpath(path)
+        p = path.strip("/")
         if self.format == StorageFormat.N5:
-            f = os.path.join(p, "attributes.json")
-            if not os.path.exists(f):
-                return False
-            with open(f) as fh:
-                return "dimensions" in json.load(fh)
-        return os.path.exists(os.path.join(p, ".zarray"))
+            raw = self._read_obj(f"{p}/attributes.json" if p else "attributes.json")
+            return raw is not None and "dimensions" in json.loads(raw)
+        return self._read_obj(f"{p}/.zarray" if p else ".zarray") is not None
 
     def exists(self, path: str) -> bool:
-        return os.path.exists(self._kvpath(path))
+        if self.is_local:
+            return os.path.exists(self._kvpath(path))
+        p = path.strip("/")
+        kv = self._kvstore()
+        # metadata-only presence checks: exact key, then any key under p/
+        if kv.list(ts.KvStore.KeyRange(p, p + "\x00")).result():
+            return True
+        keys = kv.list(ts.KvStore.KeyRange(p + "/", p + "0")).result()
+        return len(keys) > 0
 
     def remove(self, path: str = "") -> None:
-        p = self._kvpath(path) if path else self.root
-        if os.path.exists(p):
-            shutil.rmtree(p)
+        if self.is_local:
+            p = self._kvpath(path) if path else self.root
+            if os.path.exists(p):
+                shutil.rmtree(p)
+            return
+        kv = self._kvstore()
+        p = path.strip("/")
+        if p:
+            kv.delete_range(ts.KvStore.KeyRange(p + "/", p + "0")).result()
+            kv.write(p, None).result()  # delete exact key if present
+        else:
+            kv.delete_range(ts.KvStore.KeyRange()).result()
 
     def list_children(self, path: str = "") -> list[str]:
-        p = self._kvpath(path)
-        if not os.path.isdir(p):
-            return []
-        return sorted(
-            d for d in os.listdir(p) if os.path.isdir(os.path.join(p, d))
-        )
+        if self.is_local:
+            p = self._kvpath(path)
+            if not os.path.isdir(p):
+                return []
+            return sorted(
+                d for d in os.listdir(p) if os.path.isdir(os.path.join(p, d))
+            )
+        p = path.strip("/")
+        prefix = p + "/" if p else ""
+        keys = self._kvstore().list(
+            ts.KvStore.KeyRange(prefix, prefix[:-1] + "0" if prefix else "")
+        ).result()
+        kids = set()
+        for k in keys:
+            rest = k.decode()[len(prefix):]
+            if "/" in rest:
+                kids.add(rest.split("/", 1)[0])
+        return sorted(kids)
 
     def make_group(self, path: str) -> None:
-        p = self._kvpath(path)
-        os.makedirs(p, exist_ok=True)
+        if self.is_local:
+            p = self._kvpath(path)
+            os.makedirs(p, exist_ok=True)
         if self.format == StorageFormat.ZARR:
-            zg = os.path.join(p, ".zgroup")
-            if not os.path.exists(zg):
-                with open(zg, "w") as f:
-                    json.dump({"zarr_format": 2}, f)
+            rel = f"{path.strip('/')}/.zgroup"
+            if self._read_obj(rel) is None:
+                self._write_obj(rel, json.dumps({"zarr_format": 2}).encode())
 
 
 def _json_default(o):
@@ -379,8 +452,13 @@ class Hdf5Store:
     def __init__(self, path: str | os.PathLike, mode: str = "a"):
         import h5py
 
-        self.path = str(path)
+        if uris.has_scheme(path):
+            raise ValueError(
+                "HDF5 containers are local-only (the reference has the same "
+                f"restriction, CreateFusionContainer.java:141-145): {path}")
+        self.path = uris.strip_file_scheme(path)
         self.format = StorageFormat.HDF5
+        self.is_local = True
         self._f = h5py.File(self.path, mode)
 
     def create_dataset(
@@ -410,6 +488,26 @@ class Hdf5Store:
 
     def open_dataset(self, path: str) -> Dataset:
         return Dataset(self, path, self._f[path], reversed_axes=True)
+
+    def put_array(self, path: str, data: np.ndarray) -> None:
+        """Store a small auxiliary array verbatim (no axis reversal) — BDV
+        ``s{XX}/resolutions`` / ``subdivisions`` tables."""
+        if path in self._f:
+            del self._f[path]
+        self._f.create_dataset(path, data=data)
+
+    def get_array(self, path: str) -> np.ndarray | None:
+        if path not in self._f:
+            return None
+        return np.asarray(self._f[path])
+
+    def exists(self, path: str) -> bool:
+        return path.strip("/") in self._f
+
+    def is_dataset(self, path: str) -> bool:
+        import h5py
+
+        return isinstance(self._f.get(path.strip("/")), h5py.Dataset)
 
     def set_attribute(self, group: str, key_path: str, value: Any) -> None:
         g = self._f.require_group(group or "/")

@@ -132,7 +132,11 @@ def create_fusion_container(
     max_intensity: float | None = None,
 ) -> FusionContainerMeta:
     if storage_format == StorageFormat.HDF5:
-        raise NotImplementedError("HDF5 fusion container: use Hdf5Store path (local-only)")
+        return _create_fusion_container_hdf5(
+            out_path, input_xml, num_timepoints, num_channels, bbox,
+            data_type, block_size, downsamplings, compression, bdv,
+            preserve_anisotropy, anisotropy_factor, min_intensity,
+            max_intensity)
     store = ChunkStore.create(out_path, storage_format)
     dims = list(bbox.shape)
     if downsamplings is None:
@@ -203,6 +207,79 @@ def create_fusion_container(
     )
     write_container_meta(store, meta)
     return meta
+
+
+def _create_fusion_container_hdf5(
+    out_path, input_xml, num_timepoints, num_channels, bbox, data_type,
+    block_size, downsamplings, compression, bdv, preserve_anisotropy,
+    anisotropy_factor, min_intensity, max_intensity,
+) -> FusionContainerMeta:
+    """HDF5 fusion container, local-only (CreateFusionContainer.java:462-487;
+    the local-only restriction mirrors :141-145). ``bdv=True`` writes the
+    classic BigDataViewer cell layout (t{TTTTT}/s{SS}/{L}/cells plus
+    per-setup resolutions/subdivisions tables) so BDV can open the file."""
+    from .chunkstore import Hdf5Store
+
+    if os.path.exists(out_path):
+        os.remove(out_path)
+    store = Hdf5Store(out_path, mode="w")
+    dims = list(bbox.shape)
+    if downsamplings is None:
+        downsamplings = [[1, 1, 1]]
+    rel = _relative_steps(downsamplings)
+    block_size = [int(b) for b in block_size]
+    dt = np.dtype(data_type).name
+    if compression not in ("gzip", "raw"):
+        compression = "gzip"  # h5py codec surface (N5Util HDF5 writer role)
+    fusion_format = "BDV/HDF5" if bdv else "HDF5"
+
+    if bdv:
+        for c in range(num_channels):
+            store.put_array(f"s{c:02d}/resolutions",
+                            np.asarray(downsamplings, np.float64))
+            store.put_array(f"s{c:02d}/subdivisions",
+                            np.tile(np.asarray(block_size, np.int32),
+                                    (len(downsamplings), 1)))
+    mr_infos: list[list[MultiResolutionLevelInfo]] = []
+    for t in range(num_timepoints):
+        for c in range(num_channels):
+            levels = []
+            for lvl, absd in enumerate(downsamplings):
+                ldims = _level_dims(dims, absd)
+                path = (f"t{t:05d}/s{c:02d}/{lvl}/cells" if bdv
+                        else f"ch{c}tp{t}/s{lvl}")
+                store.create_dataset(path, ldims, block_size, dt,
+                                     compression=compression,
+                                     delete_existing=True)
+                levels.append(MultiResolutionLevelInfo(
+                    dataset=path, dimensions=ldims,
+                    blockSize=list(block_size), relativeDownsampling=rel[lvl],
+                    absoluteDownsampling=list(absd), dataType=dt,
+                ))
+            mr_infos.append(levels)
+
+    meta = FusionContainerMeta(
+        input_xml=input_xml, num_timepoints=num_timepoints,
+        num_channels=num_channels, bbox=bbox, data_type=dt,
+        block_size=block_size, fusion_format=fusion_format,
+        preserve_anisotropy=preserve_anisotropy,
+        anisotropy_factor=anisotropy_factor,
+        min_intensity=min_intensity, max_intensity=max_intensity,
+        mr_infos=mr_infos,
+    )
+    write_container_meta(store, meta)
+    store.close()
+    return meta
+
+
+def open_container(path: str):
+    """Open a fusion container root: HDF5 file or N5/ZARR directory/URI."""
+    if (str(path).endswith((".h5", ".hdf5"))
+            or (os.path.isfile(path) and not str(path).endswith(".xml"))):
+        from .chunkstore import Hdf5Store
+
+        return Hdf5Store(path, mode="a")
+    return ChunkStore.open(path)
 
 
 def write_container_meta(store: ChunkStore, meta: FusionContainerMeta) -> None:

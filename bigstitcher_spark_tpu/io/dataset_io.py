@@ -14,12 +14,19 @@ from typing import Sequence
 import numpy as np
 
 from ..utils.geometry import identity_affine
-from .chunkstore import ChunkStore, Dataset, StorageFormat
+from . import uris
+from .chunkstore import ChunkStore, Dataset, Hdf5Store, StorageFormat
 from .spimdata import SpimData, ViewId
 
 
 def bdv_dataset_path(setup: int, timepoint: int, level: int) -> str:
     return f"setup{setup}/timepoint{timepoint}/s{level}"
+
+
+def bdv_hdf5_dataset_path(setup: int, timepoint: int, level: int) -> str:
+    """Classic BigDataViewer HDF5 cell layout (read by the reference through
+    n5-hdf5 / bdv imgloaders, SparkResaveN5.java:107-457)."""
+    return f"t{timepoint:05d}/s{setup:02d}/{level}/cells"
 
 
 def mipmap_transform(factors: Sequence[float]) -> np.ndarray:
@@ -88,12 +95,18 @@ class ViewLoader:
     def __init__(self, spimdata: SpimData):
         self.sd = spimdata
         fmt = spimdata.image_loader.format
-        if fmt not in ("bdv.n5", "bdv.zarr"):
+        if fmt not in ("bdv.n5", "bdv.zarr", "bdv.hdf5"):
             raise NotImplementedError(f"image loader format {fmt!r} not supported yet")
         root = spimdata.resolve_loader_path()
-        if not os.path.exists(root):
-            raise FileNotFoundError(f"image container not found: {root}")
-        self.store = ChunkStore.open(root)
+        self.is_hdf5 = fmt == "bdv.hdf5"
+        if self.is_hdf5:
+            if not os.path.exists(root):
+                raise FileNotFoundError(f"image container not found: {root}")
+            self.store = Hdf5Store(root, mode="r")
+        else:
+            if not uris.has_scheme(root) and not os.path.exists(root):
+                raise FileNotFoundError(f"image container not found: {root}")
+            self.store = ChunkStore.open(root)
         self._cache: dict[tuple, Dataset] = {}
         self._factors_cache: dict[int, list[list[int]]] = {}
 
@@ -104,7 +117,13 @@ class ViewLoader:
         split = self.sd.split_info.get(setup)
         src = split[0] if split is not None else setup
         if src not in self._factors_cache:
-            f = self.store.get_attribute(f"setup{src}", "downsamplingFactors")
+            if self.is_hdf5:
+                # BDV-HDF5 keeps per-setup pyramid factors in the
+                # s{XX}/resolutions table (xyz columns)
+                res = self.store.get_array(f"s{src:02d}/resolutions")
+                f = (res.tolist() if res is not None else None)
+            else:
+                f = self.store.get_attribute(f"setup{src}", "downsamplingFactors")
             self._factors_cache[src] = [
                 [int(v) for v in row] for row in (f or [[1, 1, 1]])
             ]
@@ -116,9 +135,10 @@ class ViewLoader:
     def _open_raw(self, setup: int, timepoint: int, level: int) -> Dataset:
         key = (setup, timepoint, level)
         if key not in self._cache:
-            self._cache[key] = self.store.open_dataset(
-                bdv_dataset_path(setup, timepoint, level)
-            )
+            path = (bdv_hdf5_dataset_path(setup, timepoint, level)
+                    if self.is_hdf5
+                    else bdv_dataset_path(setup, timepoint, level))
+            self._cache[key] = self.store.open_dataset(path)
         return self._cache[key]
 
     def open(self, view: ViewId, level: int = 0) -> Dataset:

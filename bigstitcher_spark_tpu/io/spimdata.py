@@ -186,9 +186,16 @@ class SpimData:
 
     @staticmethod
     def load(path: str | os.PathLike) -> "SpimData":
+        """Load a project XML from a local path or cloud URI (the reference
+        loads XMLs from file/S3/GCS via URITools, AbstractBasic.java:49-70)."""
         path = str(path)
-        tree = ET.parse(path)
-        root = tree.getroot()
+        from . import uris
+
+        if uris.has_scheme(path):
+            root = ET.fromstring(uris.read_bytes(path).decode())
+        else:
+            path = uris.strip_file_scheme(path)
+            root = ET.parse(path).getroot()
         if root.tag != "SpimData":
             raise ValueError(f"not a SpimData XML: root tag {root.tag!r}")
         sd = SpimData()
@@ -391,7 +398,15 @@ class SpimData:
             root.append(copy.deepcopy(el))
 
         ET.indent(root)
-        ET.ElementTree(root).write(path, encoding="unicode", xml_declaration=True)
+        from . import uris
+
+        if uris.has_scheme(path):
+            buf = ET.tostring(root, encoding="unicode", xml_declaration=True)
+            uris.write_bytes(path, buf.encode())
+        else:
+            path = uris.strip_file_scheme(path)
+            ET.ElementTree(root).write(path, encoding="unicode",
+                                       xml_declaration=True)
         self.xml_path = path
 
     def _write_sequence(self, seq: ET.Element) -> None:
@@ -451,12 +466,14 @@ class SpimData:
     # ---------------------------------------------------------------- helpers
 
     def resolve_loader_path(self) -> str:
-        if self.image_loader.path_type == "absolute" or os.path.isabs(
-            self.image_loader.path
-        ):
-            return self.image_loader.path
-        base = os.path.dirname(self.xml_path or ".")
-        return os.path.normpath(os.path.join(base, self.base_path, self.image_loader.path))
+        from . import uris
+
+        lp = self.image_loader.path
+        if (self.image_loader.path_type == "absolute" or os.path.isabs(lp)
+                or uris.has_scheme(lp)):
+            return lp
+        base = uris.dirname(self.xml_path or ".")
+        return uris.normpath(uris.join(base, self.base_path, lp))
 
 
 def _parse_integer_pattern(pattern: str) -> list[int]:

@@ -411,6 +411,10 @@ def _drain_device_volume(out, out_ds, zarr_ct, io_threads=4):
     the remaining transfers with compression + disk writes."""
     from concurrent.futures import ThreadPoolExecutor
 
+    from ..io.chunkstore import StorageFormat
+
+    if getattr(out_ds.store, "format", None) == StorageFormat.HDF5:
+        io_threads = 1  # h5py writers must not run concurrently
     bs = out_ds.block_size
     step = max(int(bs[0]), 1)
     # target ~8-16 MB per slab for best tunnel throughput

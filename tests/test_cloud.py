@@ -1,0 +1,141 @@
+"""Cloud-URI storage routing (VERDICT r3 item 3).
+
+The reference reads/writes s3:// and gs:// roots everywhere via URITools +
+n5-aws-s3 (util/N5Util.java:47-80, AbstractInfrastructure.java:20-27). Here
+every root goes through tensorstore kvstore specs; these tests exercise the
+URI routing with the in-process ``memory://`` driver (a stand-in transport:
+the same code path builds s3/gcs specs) plus spec-construction unit tests
+for s3/gs that need no network.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from bigstitcher_spark_tpu.io import uris
+from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+
+
+class TestUriParsing:
+    def test_split(self):
+        assert uris.split_uri("s3://buck/a/b") == ("s3", "buck", "a/b")
+        assert uris.split_uri("gs://buck/x") == ("gs", "buck", "x")
+        assert uris.split_uri("memory://p/q") == ("memory", "", "p/q")
+        assert uris.split_uri("/local/p") == ("file", "", "/local/p")
+        assert uris.split_uri("file:///local/p") == ("file", "", "/local/p")
+
+    def test_join_dirname_normpath(self):
+        assert uris.join("s3://b/a", "c", "d") == "s3://b/a/c/d"
+        assert uris.dirname("s3://b/a/c") == "s3://b/a"
+        assert uris.normpath("s3://b/a/./x/../c") == "s3://b/a/c"
+
+    def test_s3_spec_and_region(self):
+        uris.set_s3_region(None)
+        spec = uris.kvstore_spec("s3://mybucket/root", "ds/0")
+        assert spec == {"driver": "s3", "bucket": "mybucket",
+                        "path": "root/ds/0/"}
+        uris.set_s3_region("eu-west-1")
+        try:
+            spec = uris.kvstore_spec("s3://mybucket/root")
+            assert spec["aws_region"] == "eu-west-1"
+        finally:
+            uris.set_s3_region(None)
+
+    def test_gs_spec(self):
+        spec = uris.kvstore_spec("gs://bucket-name/proj", "x")
+        assert spec == {"driver": "gcs", "bucket": "bucket-name",
+                        "path": "proj/x/"}
+
+    def test_unknown_scheme_raises(self):
+        with pytest.raises(ValueError, match="scheme"):
+            uris.kvstore_spec("ftp://x/y")
+
+    def test_bucket_root_has_no_leading_slash(self):
+        # a container rooted directly at the bucket must not prefix keys "/"
+        assert uris.kvstore_spec("s3://mybucket")["path"] == ""
+        assert uris.kvstore_spec("gs://bucket-name")["path"] == ""
+
+    def test_file_scheme_is_local(self, tmp_path):
+        # file:// URIs strip to plain local paths at every entry point
+        p = tmp_path / "x.xml"
+        p.write_text("<SpimData version='0.2'/>")
+        assert not uris.has_scheme(f"file://{p}")
+        assert uris.strip_file_scheme(f"file://{p}") == str(p)
+        assert uris.read_bytes(f"file://{p}").startswith(b"<SpimData")
+        store = ChunkStore.create(f"file://{tmp_path}/c.n5", StorageFormat.N5)
+        assert store.is_local and store.root == str(tmp_path / "c.n5")
+
+
+class TestMemoryStore:
+    """Full container lifecycle through a non-file kvstore."""
+
+    def test_n5_roundtrip(self):
+        store = ChunkStore.create("memory://t1/c.n5", StorageFormat.N5)
+        assert not store.is_local
+        ds = store.create_dataset("g/s0", (40, 30, 20), (16, 16, 16), "uint16")
+        data = np.arange(40 * 30 * 20, dtype=np.uint16).reshape(40, 30, 20)
+        ds.write(data, (0, 0, 0))
+        back = ChunkStore.open("memory://t1/c.n5")
+        assert back.format == StorageFormat.N5
+        got = back.open_dataset("g/s0").read_full()
+        assert (got == data).all()
+        assert back.is_dataset("g/s0")
+        assert not back.is_dataset("g")
+        assert back.exists("g/s0") and not back.exists("nope")
+        assert back.list_children("g") == ["s0"]
+
+    def test_attributes_roundtrip(self):
+        store = ChunkStore.create("memory://t2/c.n5", StorageFormat.N5)
+        store.set_attribute("/", "Bigstitcher-Spark/NumChannels", 3)
+        store.set_attribute("/", "Bigstitcher-Spark/Boundingbox_min", [1, 2, 3])
+        back = ChunkStore.open("memory://t2/c.n5")
+        assert back.get_attribute("/", "Bigstitcher-Spark/NumChannels") == 3
+        assert back.get_attribute("/", "Bigstitcher-Spark/Boundingbox_min") == [1, 2, 3]
+
+    def test_remove(self):
+        store = ChunkStore.create("memory://t3/c.n5", StorageFormat.N5)
+        store.create_dataset("a/b", (8, 8, 8), (8, 8, 8), "uint8")
+        assert store.exists("a/b")
+        store.remove("a")
+        assert not store.exists("a/b")
+
+    def test_zarr_fusion_container_on_memory(self, tmp_path):
+        """create-fusion-container -> open -> write through memory://."""
+        from bigstitcher_spark_tpu.io.container import create_fusion_container
+        from bigstitcher_spark_tpu.utils.geometry import Interval
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(1, 1, 1), tile_size=(24, 24, 12),
+            overlap=4, n_beads_per_tile=5)
+        bbox = Interval.from_shape((24, 24, 12))
+        root = "memory://t4/fused.ome.zarr"
+        create_fusion_container(
+            root, StorageFormat.ZARR, proj.xml_path, 1, 1, bbox,
+            data_type="uint16", block_size=(16, 16, 8),
+            min_intensity=0.0, max_intensity=65535.0)
+        store = ChunkStore.open(root)
+        assert store.format == StorageFormat.ZARR
+        assert store.get_attribute("/", "Bigstitcher-Spark/NumChannels") == 1
+        ds = store.open_dataset("0")
+        blk = np.full((16, 16, 8, 1, 1), 7, np.uint16)
+        ds.write(blk, (0, 0, 0, 0, 0))
+        got = ds.read((0, 0, 0, 0, 0), (16, 16, 8, 1, 1))
+        assert (got == 7).all()
+
+    def test_spimdata_xml_on_memory(self, tmp_path):
+        """Project XML load/save through a cloud-style URI."""
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(1, 1, 1), tile_size=(16, 16, 8),
+            overlap=4, n_beads_per_tile=3)
+        sd = SpimData.load(proj.xml_path)
+        sd.save("memory://t5/dataset.xml")
+        back = SpimData.load("memory://t5/dataset.xml")
+        assert back.view_ids() == sd.view_ids()
+        assert back.setups.keys() == sd.setups.keys()
+        # relative loader path resolves against the URI base
+        assert back.resolve_loader_path().startswith("memory://t5/")

@@ -1,0 +1,161 @@
+"""bdv.hdf5 input loader + HDF5 fusion container (VERDICT r3 item 4).
+
+The reference ingests HDF5-backed BigStitcher projects through bdv
+imgloaders (SparkResaveN5.java:107-457) and creates BDV-HDF5 fusion
+containers (CreateFusionContainer.java:462-487), restricted to local
+storage (:141-145). These tests build a classic BDV-HDF5 project
+(t{TTTTT}/s{SS}/{L}/cells + resolutions/subdivisions), read it back,
+resave it to N5, and fuse into an HDF5 container.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigstitcher_spark_tpu.io.chunkstore import Hdf5Store, StorageFormat
+from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+from bigstitcher_spark_tpu.io.spimdata import ImageLoader, SpimData
+from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+
+@pytest.fixture(scope="module")
+def hdf5_project(tmp_path_factory):
+    """Synthetic project converted to a classic BDV-HDF5 container."""
+    root = tmp_path_factory.mktemp("h5proj")
+    proj = make_synthetic_project(
+        str(root / "proj"), n_tiles=(2, 1, 1), tile_size=(32, 24, 12),
+        overlap=8, jitter=1.0, seed=3, n_beads_per_tile=10)
+    sd = SpimData.load(proj.xml_path)
+    n5_loader = ViewLoader(sd)
+    h5path = str(root / "proj" / "dataset.h5")
+    store = Hdf5Store(h5path, mode="w")
+    for v in sd.view_ids():
+        img = n5_loader.open(v, 0).read_full()
+        store.put_array(f"s{v.setup:02d}/resolutions",
+                        np.asarray([[1.0, 1.0, 1.0]]))
+        store.put_array(f"s{v.setup:02d}/subdivisions",
+                        np.asarray([[16, 16, 8]], np.int32))
+        ds = store.create_dataset(
+            f"t{v.timepoint:05d}/s{v.setup:02d}/0/cells",
+            img.shape, (16, 16, 8), img.dtype, compression="gzip")
+        ds.write(img, (0, 0, 0))
+    store.close()
+    sd.image_loader = ImageLoader(format="bdv.hdf5", path="dataset.h5")
+    sd.save()
+    return proj, h5path
+
+
+def test_hdf5_loader_reads_back(hdf5_project):
+    proj, h5path = hdf5_project
+    sd = SpimData.load(proj.xml_path)
+    assert sd.image_loader.format == "bdv.hdf5"
+    loader = ViewLoader(sd)
+    assert loader.is_hdf5
+    for v in sd.view_ids():
+        img = loader.open(v, 0).read_full()
+        assert img.shape == tuple(sd.view_size(v))
+        assert img.std() > 0
+        assert loader.downsampling_factors(v.setup) == [[1, 1, 1]]
+    # halo over-read pads with zeros
+    blk = loader.read_block(sd.view_ids()[0], 0, (-4, 0, 0), (8, 8, 8))
+    assert (blk[:4] == 0).all() and blk[4:].std() > 0
+
+
+def test_resave_from_hdf5(hdf5_project, tmp_path):
+    """resave ingests a bdv.hdf5 project and rewrites it as bdv.n5
+    (the reference's legacy-input entry point, SparkResaveN5.java:107-457)."""
+    from click.testing import CliRunner
+
+    proj, h5path = hdf5_project
+    sd_in = SpimData.load(proj.xml_path)
+    loader_in = ViewLoader(sd_in)
+    originals = {v: loader_in.open(v, 0).read_full() for v in sd_in.view_ids()}
+
+    from bigstitcher_spark_tpu.cli.main import cli
+
+    out_xml = str(tmp_path / "resaved.xml")
+    r = CliRunner().invoke(cli, [
+        "resave", "-x", proj.xml_path, "-xo", out_xml,
+        "-o", str(tmp_path / "resaved.n5"), "--N5",
+        "-ds", "1,1,1", "--blockSize", "16,16,8",
+    ], catch_exceptions=False)
+    assert r.exit_code == 0, r.output
+    sd_out = SpimData.load(out_xml)
+    assert sd_out.image_loader.format == "bdv.n5"
+    loader_out = ViewLoader(sd_out)
+    for v, img in originals.items():
+        assert (loader_out.open(v, 0).read_full() == img).all()
+
+
+def test_fuse_to_hdf5(hdf5_project, tmp_path):
+    """create-fusion-container -s HDF5 + affine-fusion round trip; output
+    agrees with the same fusion into an N5 container."""
+    from click.testing import CliRunner
+
+    from bigstitcher_spark_tpu.cli.main import cli
+    from bigstitcher_spark_tpu.io.container import (
+        open_container, read_container_meta,
+    )
+
+    proj, _ = hdf5_project
+    runner = CliRunner()
+    h5out = str(tmp_path / "fused.h5")
+    r = runner.invoke(cli, [
+        "create-fusion-container", "-x", proj.xml_path, "-o", h5out,
+        "-s", "HDF5", "-d", "UINT16", "--blockSize", "16,16,8",
+        "--minIntensity", "0", "--maxIntensity", "65535",
+    ], catch_exceptions=False)
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["affine-fusion", "-o", h5out],
+                      catch_exceptions=False)
+    assert r.exit_code == 0, r.output
+
+    n5out = str(tmp_path / "fused.n5")
+    r = runner.invoke(cli, [
+        "create-fusion-container", "-x", proj.xml_path, "-o", n5out,
+        "-s", "N5", "-d", "UINT16", "--blockSize", "16,16,8",
+        "--minIntensity", "0", "--maxIntensity", "65535",
+    ], catch_exceptions=False)
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ["affine-fusion", "-o", n5out],
+                      catch_exceptions=False)
+    assert r.exit_code == 0, r.output
+
+    h5store = open_container(h5out)
+    meta = read_container_meta(h5store)
+    assert meta.fusion_format == "HDF5"
+    got = h5store.open_dataset(meta.mr_infos[0][0].dataset).read_full()
+    n5store = open_container(n5out)
+    meta5 = read_container_meta(n5store)
+    want = n5store.open_dataset(meta5.mr_infos[0][0].dataset).read_full()
+    assert got.std() > 0
+    assert (got == want).all()
+
+
+def test_bdv_hdf5_container_layout(hdf5_project, tmp_path):
+    """--bdv HDF5 containers use the classic BDV cell layout + tables."""
+    from click.testing import CliRunner
+
+    from bigstitcher_spark_tpu.cli.main import cli
+
+    proj, _ = hdf5_project
+    out = str(tmp_path / "bdv.h5")
+    r = CliRunner().invoke(cli, [
+        "create-fusion-container", "-x", proj.xml_path, "-o", out,
+        "-s", "HDF5", "-d", "UINT16", "--bdv",
+        "--blockSize", "16,16,8",
+    ], catch_exceptions=False)
+    assert r.exit_code == 0, r.output
+    store = Hdf5Store(out, mode="r")
+    assert store.exists("t00000/s00/0/cells")
+    assert store.get_array("s00/resolutions").shape[1] == 3
+    assert store.get_array("s00/subdivisions").tolist()[0] == [16, 16, 8]
+    # the companion XML points at the hdf5 loader
+    sd = SpimData.load(out + ".xml")
+    assert sd.image_loader.format == "bdv.hdf5"
+
+
+def test_hdf5_is_local_only():
+    with pytest.raises(ValueError, match="local-only"):
+        Hdf5Store("s3://bucket/x.h5")
