@@ -128,6 +128,35 @@ def create_fusion_container_cmd(xml, output, storage, data_type, block_size,
         click.echo("(dry run, not writing)")
         return
 
+    bdv_xml = xml_out or output + ".xml"
+    setup_offset = 0
+    append_sd = None
+    if bdv and os.path.exists(bdv_xml):
+        # fuse into the EXISTING BDV project: new ViewSetups get the next
+        # setup/channel ids (BDVSparkInstantiateViewSetup.java:57-112)
+        if storage_format != StorageFormat.N5:
+            raise click.ClickException(
+                "appending to an existing BDV project XML is supported for "
+                "N5 containers (delete the XML for a fresh project)")
+        append_sd = SpimData.load(bdv_xml)
+        existing_root = append_sd.resolve_loader_path()
+
+        def canon(p):
+            from ..io import uris
+
+            return (uris.normpath(p) if has_scheme(p)
+                    else os.path.realpath(p))
+
+        if canon(existing_root) != canon(output):
+            raise click.ClickException(
+                f"existing BDV project {bdv_xml} points at container "
+                f"{existing_root!r}, not the requested output {output!r} — "
+                "refusing to append (pick the project's own container, or a "
+                "fresh --xmlout)")
+        setup_offset = max(append_sd.setups) + 1 if append_sd.setups else 0
+        click.echo(f"appending to existing BDV project {bdv_xml}: "
+                   f"new setups start at {setup_offset}")
+
     meta = create_fusion_container(
         output, storage_format, _abs_if_local(xml),
         num_timepoints, num_channels, bbox,
@@ -136,10 +165,42 @@ def create_fusion_container_cmd(xml, output, storage, data_type, block_size,
         preserve_anisotropy=preserve_anisotropy,
         anisotropy_factor=anisotropy_factor,
         min_intensity=min_intensity, max_intensity=max_intensity,
+        setup_id_offset=setup_offset,
     )
-    if bdv:
-        _write_bdv_output_xml(xml_out or output + ".xml", output, meta, storage_format)
+    if bdv and append_sd is not None:
+        _append_bdv_output_xml(append_sd, bdv_xml, meta, setup_offset)
+    elif bdv:
+        _write_bdv_output_xml(bdv_xml, output, meta, storage_format)
     click.echo(f"created {meta.fusion_format} container at {output}")
+
+
+def _append_bdv_output_xml(sd, xml_out: str, meta, setup_offset: int) -> None:
+    """Append this fusion's ViewSetups to an existing BDV project: next
+    channel ids, identity registrations, shared container
+    (BDVSparkInstantiateViewSetup.java:57-112 — the default rule increments
+    the channel when nothing else distinguishes the new setups)."""
+    from ..io.spimdata import AttributeEntity, ViewSetup, ViewTransform
+    from ..utils.geometry import identity_affine
+
+    next_channel = max(sd.attributes["channel"], default=-1) + 1
+    dims = meta.bbox.shape
+    for c in range(meta.num_channels):
+        ch = next_channel + c
+        sid = setup_offset + c
+        sd.attributes["channel"][ch] = AttributeEntity(ch, f"Channel {ch}")
+        sd.setups[sid] = ViewSetup(
+            id=sid, name=f"setup {sid}", size=tuple(dims),
+            attributes={"illumination": 0, "channel": ch, "tile": 0,
+                        "angle": 0},
+        )
+        for t in range(meta.num_timepoints):
+            if t not in sd.timepoints:
+                sd.timepoints.append(t)
+            sd.registrations[ViewId(t, sid)] = [
+                ViewTransform("fused", identity_affine())
+            ]
+    sd.timepoints.sort()
+    sd.save(xml_out)
 
 
 def _write_bdv_output_xml(xml_out: str, container: str, meta, storage_format) -> None:
@@ -288,12 +349,16 @@ def affine_fusion_cmd(output, fusion_type, block_scale, masks, mask_offset,
 
 def _write_pyramid(store, mr_levels, is_zarr5d, ct):
     """Downsample s0 into the remaining pyramid levels
-    (SparkAffineFusion.java:703-782)."""
+    (SparkAffineFusion.java:703-782). Each level reads chunks the previous
+    stage may have written on another host -> barrier per boundary."""
     from ..models.downsample_driver import downsample_pyramid_level
+    from ..parallel.distributed import barrier
 
+    barrier("fusion-s0")
     for lvl in range(1, len(mr_levels)):
         downsample_pyramid_level(store, mr_levels[lvl - 1], mr_levels[lvl],
                                  is_zarr5d, ct)
+        barrier(f"fusion-s{lvl}")
 
 
 @click.command()

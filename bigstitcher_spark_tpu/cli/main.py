@@ -23,6 +23,11 @@ from . import (
 @click.group()
 def cli():
     """TPU-native BigStitcher: distributed stitching & fusion tools."""
+    # multi-host bootstrap: no-op unless BST_COORDINATOR/BST_NUM_PROCESSES/
+    # BST_PROCESS_ID (or BST_DISTRIBUTED=1 on an autodetecting pod) are set
+    from ..parallel.distributed import init_distributed
+
+    init_distributed()
 
 
 cli.add_command(fusion_tools.create_fusion_container_cmd, "create-fusion-container")

@@ -130,7 +130,11 @@ def create_fusion_container(
     anisotropy_factor: float = float("nan"),
     min_intensity: float | None = None,
     max_intensity: float | None = None,
+    setup_id_offset: int = 0,
 ) -> FusionContainerMeta:
+    """``setup_id_offset``: first BDV setup id to create — nonzero when
+    appending a fusion into an existing BDV project (the next channel/tile
+    setup ids, BDVSparkInstantiateViewSetup.java:57-112)."""
     if storage_format == StorageFormat.HDF5:
         return _create_fusion_container_hdf5(
             out_path, input_xml, num_timepoints, num_channels, bbox,
@@ -172,10 +176,11 @@ def create_fusion_container(
         for t in range(num_timepoints):
             for c in range(num_channels):
                 if bdv:
-                    prefix = f"setup{c}/timepoint{t}"
-                    store.set_attribute(f"setup{c}", "downsamplingFactors",
+                    s_id = c + setup_id_offset
+                    prefix = f"setup{s_id}/timepoint{t}"
+                    store.set_attribute(f"setup{s_id}", "downsamplingFactors",
                                         [list(a) for a in downsamplings])
-                    store.set_attribute(f"setup{c}", "dataType", dt)
+                    store.set_attribute(f"setup{s_id}", "dataType", dt)
                 else:
                     prefix = f"ch{c}tp{t}"
                 levels = []

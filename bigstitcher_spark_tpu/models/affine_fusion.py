@@ -543,6 +543,7 @@ def _fuse_volume_sharded(
             run_sharded_batches(
                 items, build, kernel_call, consume, n_dev, pool,
                 label=f"fusion batch {key}", progress=progress,
+                multihost=True,
             )
             stats.voxels += sum(written.values())
     finally:

@@ -77,7 +77,8 @@ def make_downsample_kernel(n_dev: int, rel):
 
 def run_sharded_downsample(jobs, read_job, write_job, rel, devices=None,
                            io_threads: int = 8, per_dev: int = 4,
-                           label: str = "downsample block") -> None:
+                           label: str = "downsample block",
+                           multihost: bool = True) -> None:
     """Downsample every (job, src-box) through the mesh. ``read_job(job)``
     returns the raw source box (size = out_block * rel, edge-padded);
     ``write_job(job, data)`` converts + writes. Jobs are bucketed by source
@@ -98,6 +99,7 @@ def run_sharded_downsample(jobs, read_job, write_job, rel, devices=None,
                 kernel,
                 write_job,
                 n_dev, pool, label=label, per_dev=per_dev,
+                multihost=multihost,
             )
     finally:
         pool.shutdown(wait=True)

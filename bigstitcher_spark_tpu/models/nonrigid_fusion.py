@@ -234,7 +234,7 @@ def fuse_nonrigid_volume(
 
             run_sharded_batches(items, build, kernel_call, consume, n_dev,
                                 pool, label="nonrigid batch",
-                                progress=progress)
+                                progress=progress, multihost=True)
             stats.voxels += sum(written.values())
     finally:
         pool.shutdown(wait=True)

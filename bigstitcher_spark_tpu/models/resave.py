@@ -100,8 +100,12 @@ def resave(
         data = src.read(blk.offset, blk.size)
         per_view_datasets[v][0].write(data, blk.offset)
 
+    from ..parallel.distributed import barrier, partition_items
+
+    s0_jobs = partition_items(s0_jobs)  # multi-host: each process its slice
     run_with_retry(s0_jobs, copy_s0, label="resave s0 block", threads=threads)
     stats.s0_blocks = len(s0_jobs)
+    barrier("resave-s0")  # level 1 reads s0 chunks other processes wrote
 
     # pyramid levels from the previous level, block-sharded over the device
     # mesh across ALL views at once (SparkResaveN5.java:336-415)
@@ -125,10 +129,12 @@ def resave(
             dst = per_view_datasets[v][level]
             dst.write(_convert_to_dtype(out, dst.dtype), blk.offset)
 
+        level_jobs = partition_items(level_jobs)
         run_sharded_downsample(level_jobs, read_job, write_job, f,
                                devices=devices, io_threads=threads,
-                               label=f"resave s{lvl} block")
+                               label=f"resave s{lvl} block", multihost=False)
         stats.pyramid_blocks += len(level_jobs)
+        barrier(f"resave-s{lvl}")  # next level reads this level's chunks
 
     stats.seconds = time.time() - t0
     return stats

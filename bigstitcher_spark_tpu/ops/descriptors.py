@@ -35,16 +35,43 @@ ICP = "ICP"
 # descriptors
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def knn_indices(points: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Indices of the k nearest neighbors (self excluded) for each of N
-    points — dense (N,N) distance matrix + top-k; fine for the 1e3–1e5
-    points per view this pipeline sees."""
+# row-tile budget: a distance tile holds at most this many f32 entries
+# (2^26 = 256 MB), so big clouds never materialize an (N,N) matrix
+_TILE_ENTRIES = 1 << 26
+
+
+def _row_block(n: int) -> int:
+    r = max(128, _TILE_ENTRIES // max(n, 1))
+    return int(min(1 << int(np.ceil(np.log2(r))), max(n, 1)))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "rb"))
+def _knn_kernel(points: jnp.ndarray, k: int, rb: int) -> jnp.ndarray:
+    """(N,k) nearest-neighbor indices, row-tiled: each lax.map step builds
+    one (rb, N) distance tile — memory stays O(rb*N) instead of O(N^2), so
+    1e5-point clouds (the reference handles these via KD-trees) fit HBM."""
     p = points.astype(jnp.float32)
-    d2 = ((p[:, None, :] - p[None, :, :]) ** 2).sum(-1)
-    d2 = d2 + jnp.eye(p.shape[0], dtype=jnp.float32) * jnp.inf
-    _, idx = jax.lax.top_k(-d2, k)
-    return idx
+    n = p.shape[0]
+    pad_rows = (-n) % rb
+    rows = jnp.pad(p, ((0, pad_rows), (0, 0)))
+    row_ids = jnp.arange(n + pad_rows, dtype=jnp.int32)
+
+    def block(args):
+        rp, rid = args
+        d2 = ((rp[:, None, :] - p[None, :, :]) ** 2).sum(-1)  # (rb, N)
+        d2 = jnp.where(rid[:, None] == jnp.arange(n)[None, :], jnp.inf, d2)
+        _, idx = jax.lax.top_k(-d2, k)
+        return idx
+
+    idx = jax.lax.map(block, (rows.reshape(-1, rb, 3),
+                              row_ids.reshape(-1, rb)))
+    return idx.reshape(-1, k)[:n]
+
+
+def knn_indices(points, k: int):
+    """Indices of the k nearest neighbors (self excluded) for each point."""
+    n = int(points.shape[0])
+    return _knn_kernel(jnp.asarray(points), k, _row_block(n))
 
 
 def subset_combinations(n_pool: int, n_use: int) -> np.ndarray:
@@ -116,15 +143,7 @@ def _pairwise_sqdist(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 @jax.jit
-def match_ratio_test(desc_a, owner_a, desc_b, owner_b, ratio: jnp.ndarray):
-    """Best-vs-second-best candidate matching.
-
-    For each descriptor of A: nearest and second-nearest descriptor of B
-    (second-nearest restricted to a DIFFERENT owner point, so redundant
-    descriptors of one point don't veto themselves); accept if
-    second/best >= ratio (mpicbg nearest-neighbor-distance-ratio test).
-    Returns (match_b (Da,) int32 owner index in B, accept (Da,) bool).
-    """
+def _match_ratio_dense(desc_a, owner_a, desc_b, owner_b, ratio: jnp.ndarray):
     d2 = _pairwise_sqdist(desc_a, desc_b)                 # (Da, Db)
     best = jnp.argmin(d2, axis=1)
     bestd = jnp.take_along_axis(d2, best[:, None], axis=1)[:, 0]
@@ -133,6 +152,78 @@ def match_ratio_test(desc_a, owner_a, desc_b, owner_b, ratio: jnp.ndarray):
     second = jnp.min(d2_masked, axis=1)
     accept = jnp.sqrt(second) >= ratio * jnp.sqrt(bestd)
     return owner_b[best], accept
+
+
+@functools.partial(jax.jit, static_argnames=("cb", "topk"))
+def _match_ratio_row_chunk(desc_r, desc_b, owner_b, ratio, cb: int,
+                           topk: int):
+    """One row chunk of the tiled ratio test: scan B in ``cb``-column tiles
+    keeping a running per-row top-``topk`` (distance, owner) — memory is
+    O(rows*cb). topk must exceed the per-owner descriptor multiplicity so
+    the best different-owner distance survives the truncation."""
+    db = desc_b.shape[0]
+    pad = (-db) % cb
+    # pad with zeros (scale-neutral for the centered matmul — huge pad
+    # values would wreck the a^2+b^2-2ab cancellation) and mask by owner
+    descs = jnp.pad(desc_b, ((0, pad), (0, 0)))
+    owners = jnp.pad(owner_b, (0, pad), constant_values=-1)
+    r = desc_r.shape[0]
+    init = (jnp.full((r, topk), jnp.inf, jnp.float32),
+            jnp.full((r, topk), -1, jnp.int32))
+
+    def step(carry, tile):
+        vals, owns = carry
+        dt, ot = tile
+        d2 = _pairwise_sqdist(desc_r, dt)                 # (r, cb)
+        d2 = jnp.where(ot[None, :] == -1, jnp.inf, d2)
+        allv = jnp.concatenate([vals, d2], axis=1)
+        allo = jnp.concatenate([owns, jnp.broadcast_to(ot, (r, cb))], axis=1)
+        nv, ni = jax.lax.top_k(-allv, topk)
+        return (-nv, jnp.take_along_axis(allo, ni, axis=1)), None
+
+    (vals, owns), _ = jax.lax.scan(
+        step, init, (descs.reshape(-1, cb, descs.shape[1]),
+                     owners.reshape(-1, cb)))
+    best_owner = owns[:, 0]
+    bestd = vals[:, 0]
+    diff = owns != best_owner[:, None]
+    second = jnp.min(jnp.where(diff, vals, jnp.inf), axis=1)
+    accept = jnp.sqrt(second) >= ratio * jnp.sqrt(bestd)
+    return best_owner, accept
+
+
+def match_ratio_test(desc_a, owner_a, desc_b, owner_b, ratio,
+                     max_owner_multiplicity: int = 6):
+    """Best-vs-second-best candidate matching.
+
+    For each descriptor of A: nearest and second-nearest descriptor of B
+    (second-nearest restricted to a DIFFERENT owner point, so redundant
+    descriptors of one point don't veto themselves); accept if
+    second/best >= ratio (mpicbg nearest-neighbor-distance-ratio test).
+    Returns (match_b (Da,) int32 owner index in B, accept (Da,) bool).
+
+    Small problems take the dense (Da,Db) kernel; large ones are tiled in
+    row chunks x column tiles with a running top-k, so 1e5-point views
+    (dense would need tens of GB) run in bounded memory.
+    """
+    da, db = int(desc_a.shape[0]), int(desc_b.shape[0])
+    if da * db <= _TILE_ENTRIES:
+        return _match_ratio_dense(desc_a, owner_a, desc_b, owner_b,
+                                  jnp.float32(ratio))
+    desc_a = jnp.asarray(desc_a)
+    desc_b = jnp.asarray(desc_b)
+    owner_b = jnp.asarray(owner_b)
+    rb = _row_block(min(db, 1 << 16))
+    cb = 1 << 14
+    topk = max(8, max_owner_multiplicity + 2)
+    outs_o, outs_a = [], []
+    for i in range(0, da, rb):
+        chunk = desc_a[i:i + rb]
+        o, a = _match_ratio_row_chunk(chunk, desc_b, owner_b,
+                                      jnp.float32(ratio), cb, topk)
+        outs_o.append(np.asarray(o))
+        outs_a.append(np.asarray(a))
+    return np.concatenate(outs_o), np.concatenate(outs_a)
 
 
 def match_candidates(
@@ -156,8 +247,11 @@ def match_candidates(
                                n_neighbors, redundancy, rot)
     db, ob = build_descriptors(jnp.asarray(points_b, jnp.float32),
                                n_neighbors, redundancy, rot)
+    # per-owner descriptor multiplicity bounds the tiled top-k truncation
+    n_subsets = len(subset_combinations(pool, n_neighbors))
     mb, acc = match_ratio_test(da, oa, db, ob,
-                               jnp.float32(ratio_of_distance))
+                               jnp.float32(ratio_of_distance),
+                               max_owner_multiplicity=n_subsets)
     oa, mb, acc = np.asarray(oa), np.asarray(mb), np.asarray(acc)
     pairs = np.stack([oa[acc], mb[acc]], axis=1)
     return np.unique(pairs, axis=0).astype(np.int32)
@@ -200,6 +294,28 @@ def _ransac_kernel(pa, pb, valid, key, epsilon, lam,
     return final, inliers, counts[best]
 
 
+@functools.partial(
+    jax.jit, static_argnames=("model_kind", "iterations", "sample"),
+)
+def _ransac_score_chunk(pa, pb, valid, key, epsilon,
+                        model_kind, iterations, sample):
+    """Score one chunk of hypotheses; returns (best_count, best_model).
+    Used for big candidate sets where (10k, M) error matrices would not fit;
+    the (iterations, M) tile is bounded by the caller's chunking."""
+    m = pa.shape[0]
+    keys = jax.random.split(key, iterations)
+    idx = jax.vmap(
+        lambda k: jax.random.choice(k, m, (sample,), replace=False,
+                                    p=valid / valid.sum())
+    )(keys)
+    models = fit_model(model_kind, pa[idx], pb[idx], xp=jnp)
+    pred = jnp.einsum("iab,mb->ima", models[:, :, :3], pa) + models[:, None, :, 3]
+    err = jnp.linalg.norm(pred - pb[None], axis=-1)
+    counts = ((err < epsilon) & (valid[None, :] > 0)).sum(-1)
+    best = jnp.argmax(counts)
+    return counts[best], models[best]
+
+
 def ransac(
     cand_a: np.ndarray,
     cand_b: np.ndarray,
@@ -218,7 +334,8 @@ def ransac(
     (model 3x4, inlier_mask (M,)) or None if consensus is too small
     (RANSAC defaults: SparkGeometricDescriptorMatching.java:180-189).
     Candidates are padded to the next power of two so compilation is shared
-    across pairs of similar size.
+    across pairs of similar size. Sets too large for one (10k, M) error
+    matrix are scored in iteration chunks with the consensus refits on host.
     """
     m = len(cand_a)
     sample = max(MIN_POINTS[model_kind], MIN_POINTS.get(reg_kind, 0), 1)
@@ -229,12 +346,40 @@ def ransac(
     pb = np.zeros((padded, 3), np.float32)
     val = np.zeros(padded, np.float32)
     pa[:m], pb[:m], val[:m] = cand_a, cand_b, 1.0
-    model, inliers, _ = _ransac_kernel(
-        jnp.asarray(pa), jnp.asarray(pb), jnp.asarray(val),
-        jax.random.PRNGKey(seed), jnp.float32(epsilon), float(lam),
-        model_kind, reg_kind, int(iterations), int(sample),
-    )
-    inliers = np.asarray(inliers)[:m]
+
+    if int(iterations) * padded <= _TILE_ENTRIES * 2:
+        model, inliers, _ = _ransac_kernel(
+            jnp.asarray(pa), jnp.asarray(pb), jnp.asarray(val),
+            jax.random.PRNGKey(seed), jnp.float32(epsilon), float(lam),
+            model_kind, reg_kind, int(iterations), int(sample),
+        )
+        inliers = np.asarray(inliers)[:m]
+    else:
+        chunk = max(64, (_TILE_ENTRIES * 2) // padded)
+        ja, jb, jv = jnp.asarray(pa), jnp.asarray(pb), jnp.asarray(val)
+        best_count, best_model = -1, None
+        done = 0
+        while done < int(iterations):
+            it = int(min(chunk, int(iterations) - done))
+            c, mdl = _ransac_score_chunk(
+                ja, jb, jv, jax.random.PRNGKey(seed + done),
+                jnp.float32(epsilon), model_kind, it, int(sample))
+            if int(c) > best_count:
+                best_count, best_model = int(c), np.asarray(mdl, np.float64)
+            done += it
+        # consensus refits on host (mirror of _ransac_kernel's tail)
+        a64 = np.asarray(cand_a, np.float64)
+        b64 = np.asarray(cand_b, np.float64)
+        w = (np.linalg.norm(
+            a64 @ best_model[:, :3].T + best_model[:, 3] - b64, axis=-1)
+            < epsilon).astype(np.float64)
+        mdl = fit_interpolated(model_kind, reg_kind, lam, a64, b64, w)
+        w2 = (np.linalg.norm(a64 @ mdl[:, :3].T + mdl[:, 3] - b64, axis=-1)
+              < epsilon).astype(np.float64)
+        mdl = fit_interpolated(model_kind, reg_kind, lam, a64, b64, w2)
+        inliers = np.linalg.norm(
+            a64 @ mdl[:, :3].T + mdl[:, 3] - b64, axis=-1) < epsilon
+
     n_in = int(inliers.sum())
     if n_in < min_inliers or n_in < min_inlier_ratio * m:
         return None

@@ -1,0 +1,111 @@
+"""Multi-host scale-out: process bootstrap + deterministic work partition.
+
+The reference scales by launching Spark executors on many nodes (LSF/SGE via
+flintstone, EMR/Dataproc — src/main/scripts/flintstone-sge-example.sh:29-119,
+pom.xml:200-260); work items are distributed by the Spark driver. The TPU
+analogue (SURVEY §2.5) is SPMD: every host runs the SAME driver program,
+``jax.distributed.initialize`` wires the processes into one runtime (ICI
+within a pod slice, DCN across), and each process takes a deterministic
+slice of the same host-side work list, sharding it over its LOCAL devices.
+Block writers own disjoint output chunks (the reference's no-shuffle
+invariant), so no cross-host communication is needed for fusion / resave /
+downsample / nonrigid — exactly like the reference's executors.
+
+Launch recipe (two hosts):
+
+    # host 0                                           # host 1
+    BST_COORDINATOR=host0:8476 \
+    BST_NUM_PROCESSES=2 BST_PROCESS_ID=0 \
+    bst affine-fusion -o out.zarr                      ... BST_PROCESS_ID=1 ...
+
+(or on Cloud TPU pods just run the command on every worker —
+``jax.distributed.initialize()`` autodetects the topology there).
+
+Stages that COLLECT results to the project XML (detection, matching,
+stitching, solver) follow the reference's driver-side-collect design and
+should run single-process; the block-writing stages are where the volume is.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+_initialized = [False]
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize the multi-host runtime (jax.distributed) once per process.
+
+    Arguments default to ``BST_COORDINATOR`` / ``BST_NUM_PROCESSES`` /
+    ``BST_PROCESS_ID``; returns True when a multi-process runtime was set up,
+    False for the ordinary single-process case (no env, no args)."""
+    if _initialized[0]:
+        return True
+    coordinator_address = coordinator_address or os.environ.get("BST_COORDINATOR")
+    if num_processes is None and os.environ.get("BST_NUM_PROCESSES"):
+        num_processes = int(os.environ["BST_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("BST_PROCESS_ID"):
+        process_id = int(os.environ["BST_PROCESS_ID"])
+    import jax
+
+    if coordinator_address is None and num_processes is None:
+        if os.environ.get("BST_DISTRIBUTED"):
+            # Cloud TPU pod / SLURM: topology autodetected by jax
+            jax.distributed.initialize()
+            _initialized[0] = True
+            return True
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized[0] = True
+    return True
+
+
+def barrier(name: str = "bst") -> None:
+    """Cross-host barrier for read-after-write stage boundaries (e.g. s0
+    copy -> pyramid level 1, level k -> level k+1): a later stage may read
+    chunks another process wrote, so all processes must pass the boundary
+    together. No-op at world size 1 (the reference gets the same ordering
+    from Spark's stage-by-stage collect)."""
+    if world()[1] <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def world() -> tuple[int, int]:
+    """(process_index, process_count) of the current runtime."""
+    import jax
+
+    return jax.process_index(), jax.process_count()
+
+
+def partition_items(
+    items: Sequence,
+    process_index: int | None = None,
+    process_count: int | None = None,
+) -> list:
+    """This process's slice of a work list: strided round-robin
+    ``items[i::count]`` — deterministic, covers every item exactly once
+    across processes, degenerates to the full list at world size 1, and
+    interleaves neighbouring (similar-cost) blocks across hosts for balance.
+    """
+    if process_index is None or process_count is None:
+        pi, pc = world()
+        process_index = pi if process_index is None else process_index
+        process_count = pc if process_count is None else process_count
+    if process_count <= 1:
+        return list(items)
+    if not (0 <= process_index < process_count):
+        raise ValueError(
+            f"process_index {process_index} outside world size {process_count}")
+    return list(items[process_index::process_count])

@@ -119,6 +119,7 @@ def run_sharded_batches(
     label: str = "batch",
     progress: bool = False,
     per_dev: int = 1,
+    multihost: bool = False,
 ):
     """The shared multi-device work loop: every sharded stage driver (fusion,
     detection, nonrigid, downsample) is this pattern — the TPU replacement of
@@ -136,9 +137,18 @@ def run_sharded_batches(
     buffering); batches are resubmitted on failure via run_with_retry, and
     completed batches are tracked so retry rounds neither re-run them nor
     leak prefetch futures. ``per_dev`` packs that many items per device per
-    batch (compute-light kernels amortize dispatch by batching more)."""
+    batch (compute-light kernels amortize dispatch by batching more).
+
+    ``multihost=True`` (block-writing stages only — outputs must be disjoint
+    chunks) first takes this process's deterministic slice of ``items``, so
+    the same driver run on N hosts covers the grid exactly once
+    (parallel.distributed; the reference's executor model, SURVEY §2.5)."""
     from .retry import run_with_retry
 
+    if multihost:
+        from .distributed import partition_items
+
+        items = partition_items(items)
     group = n_dev * max(1, per_dev)
     batches = [list(items[i:i + group]) for i in range(0, len(items), group)]
     if not batches:

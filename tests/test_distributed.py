@@ -1,0 +1,120 @@
+"""Multi-host scale-out skeleton (VERDICT r3 item 6; SURVEY §2.5).
+
+Real multi-host can't run here, so these tests check the pieces the launch
+recipe relies on: the deterministic work partition covers the grid exactly
+once at any world size, degenerates at world_size=1, and the production
+fusion driver composed over a faked 2-process world writes exactly the full
+volume (each process its disjoint slice) — the reference's executor model
+(flintstone-sge-example.sh:29-119) without Spark.
+"""
+
+import numpy as np
+import pytest
+
+from bigstitcher_spark_tpu.parallel.distributed import (
+    init_distributed, partition_items, world,
+)
+
+
+class TestPartition:
+    def test_covers_exactly_once(self):
+        items = list(range(103))
+        for count in (1, 2, 3, 8):
+            slices = [partition_items(items, i, count) for i in range(count)]
+            merged = sorted(x for s in slices for x in s)
+            assert merged == items
+            # balanced to within one item
+            sizes = [len(s) for s in slices]
+            assert max(sizes) - min(sizes) <= 1
+
+    def test_world_size_one_is_identity(self):
+        items = ["a", "b", "c"]
+        assert partition_items(items, 0, 1) == items
+
+    def test_current_process_defaults(self):
+        # single-process runtime: jax world is (0, 1) -> identity
+        assert world() == (0, 1)
+        assert partition_items([1, 2, 3]) == [1, 2, 3]
+
+    def test_bad_index_raises(self):
+        with pytest.raises(ValueError, match="world size"):
+            partition_items([1], 5, 2)
+
+    def test_init_noop_without_config(self, monkeypatch):
+        for k in ("BST_COORDINATOR", "BST_NUM_PROCESSES", "BST_PROCESS_ID"):
+            monkeypatch.delenv(k, raising=False)
+        assert init_distributed() is False
+
+
+class TestFusedGridAcrossProcesses:
+    def test_two_fake_processes_write_full_volume(self, tmp_path, monkeypatch):
+        """Run the sharded fusion driver twice with a faked 2-process world;
+        the union of writes must equal the single-process output exactly."""
+        from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models.affine_fusion import fuse_volume
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+        from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+        import bigstitcher_spark_tpu.parallel.mesh as mesh_mod
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(2, 1, 1), tile_size=(32, 32, 16),
+            overlap=8, jitter=1.0, seed=7, n_beads_per_tile=8)
+        sd = SpimData.load(proj.xml_path)
+        loader = ViewLoader(sd)
+        views = sd.view_ids()
+        bbox = maximal_bounding_box(sd, views)
+
+        def fuse(name, fake_world=None):
+            if fake_world is not None:
+                monkeypatch.setattr(
+                    "bigstitcher_spark_tpu.parallel.distributed.world",
+                    lambda: fake_world)
+            store = ChunkStore.create(str(tmp_path / f"{name}.n5"),
+                                      StorageFormat.N5)
+            ds = store.create_dataset("f", bbox.shape, (16, 16, 8), "uint16")
+            fuse_volume(sd, loader, views, ds, bbox, block_size=(16, 16, 8),
+                        block_scale=(1, 1, 1), out_dtype="uint16", devices=2)
+            return ds
+
+        single = fuse("single").read_full()
+        # two fake processes write into the SAME container
+        store = ChunkStore.create(str(tmp_path / "multi.n5"), StorageFormat.N5)
+        ds = store.create_dataset("f", bbox.shape, (16, 16, 8), "uint16")
+        for pi in (0, 1):
+            monkeypatch.setattr(
+                "bigstitcher_spark_tpu.parallel.distributed.world",
+                lambda pi=pi: (pi, 2))
+            fuse_volume(sd, loader, views, ds, bbox, block_size=(16, 16, 8),
+                        block_scale=(1, 1, 1), out_dtype="uint16", devices=2)
+        multi = ds.read_full()
+        assert single.std() > 0
+        assert (multi == single).all()
+
+    def test_fake_single_process_slice_is_partial(self, tmp_path, monkeypatch):
+        """Process 0 of 2 alone must NOT cover the full grid (proves the
+        partition actually prunes work rather than duplicating it)."""
+        from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models.affine_fusion import fuse_volume
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+        from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj2"), n_tiles=(2, 1, 1), tile_size=(32, 32, 16),
+            overlap=8, jitter=0.0, seed=8, n_beads_per_tile=8)
+        sd = SpimData.load(proj.xml_path)
+        loader = ViewLoader(sd)
+        views = sd.view_ids()
+        bbox = maximal_bounding_box(sd, views)
+        monkeypatch.setattr(
+            "bigstitcher_spark_tpu.parallel.distributed.world",
+            lambda: (0, 2))
+        store = ChunkStore.create(str(tmp_path / "part.n5"), StorageFormat.N5)
+        ds = store.create_dataset("f", bbox.shape, (16, 16, 8), "uint16")
+        stats = fuse_volume(sd, loader, views, ds, bbox,
+                            block_size=(16, 16, 8), block_scale=(1, 1, 1),
+                            out_dtype="uint16", devices=2)
+        assert 0 < stats.voxels < int(np.prod(bbox.shape))

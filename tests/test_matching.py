@@ -3,6 +3,8 @@ known transforms, plus the detect -> match -> solve pipeline on the synthetic
 project (the IP-source registration path the reference exercises via
 match-interestpoints + solver, SURVEY.md §3.4/§3.5)."""
 
+import os
+
 import numpy as np
 import pytest
 from click.testing import CliRunner
@@ -395,3 +397,67 @@ def test_cli_match(tmp_path):
     sd = SpimData.load(proj.xml_path)
     store = InterestPointStore.for_project(sd)
     assert len(store.load_correspondences(ViewId(0, 0), "beads")) > 0
+
+
+class TestTiledMatching:
+    """Row/column-tiled kNN + ratio test + chunked RANSAC: large point
+    clouds must run in bounded memory (the reference handles them with
+    KD-trees; dense (N,N)/(Da,Db) matrices OOM at 1e5 — VERDICT r3 item 7),
+    and the tiled kernels must agree exactly with the dense ones."""
+
+    def test_knn_tiled_equals_dense(self, monkeypatch):
+        import bigstitcher_spark_tpu.ops.descriptors as D
+
+        rng = np.random.default_rng(4)
+        pts = rng.uniform(0, 100, (500, 3)).astype(np.float32)
+        dense = np.asarray(D.knn_indices(pts, 4))
+        monkeypatch.setattr(D, "_TILE_ENTRIES", 1 << 10)  # force tiny tiles
+        D._knn_kernel.clear_cache()
+        tiled = np.asarray(D.knn_indices(pts, 4))
+        assert (dense == tiled).all()
+
+    def test_ratio_test_tiled_equals_dense(self, monkeypatch):
+        import bigstitcher_spark_tpu.ops.descriptors as D
+
+        rng = np.random.default_rng(5)
+        pts_a = rng.uniform(0, 300, (800, 3)).astype(np.float32)
+        pts_b = (pts_a + np.array([2.0, -1.0, 0.5])
+                 + rng.normal(0, 0.1, pts_a.shape)).astype(np.float32)
+        dense = D.match_candidates(pts_a, pts_b, method=D.RGLDM)
+        monkeypatch.setattr(D, "_TILE_ENTRIES", 1 << 12)
+        tiled = D.match_candidates(pts_a, pts_b, method=D.RGLDM)
+        assert len(dense) > 400
+        assert np.array_equal(dense, tiled)
+
+    def test_chunked_ransac_recovers_translation(self):
+        """M large enough to force the iteration-chunked scorer (a dense
+        (10k, M) error matrix would be multiple GB)."""
+        import bigstitcher_spark_tpu.ops.descriptors as D
+
+        rng = np.random.default_rng(2)
+        m = 40000
+        a = rng.uniform(0, 500, (m, 3))
+        t = np.array([3.2, -1.7, 0.9])
+        b = a + t + rng.normal(0, 0.3, a.shape)
+        b[:m // 4] = rng.uniform(0, 500, (m // 4, 3))  # 25% outliers
+        res = D.ransac(a.astype(np.float32), b.astype(np.float32),
+                       model_kind="TRANSLATION", reg_kind="NONE",
+                       iterations=2000)
+        assert res is not None
+        model, inl = res
+        np.testing.assert_allclose(model[:, 3], t, atol=0.05)
+        assert inl.sum() >= 0.7 * (m - m // 4)
+
+    @pytest.mark.skipif(not os.environ.get("BST_BIG_TESTS"),
+                        reason="1e5-point soak (minutes on 1 CPU core); "
+                               "set BST_BIG_TESTS=1 to run")
+    def test_1e5_point_match_bounded_memory(self):
+        import bigstitcher_spark_tpu.ops.descriptors as D
+
+        rng = np.random.default_rng(6)
+        n = 100_000
+        pts_a = rng.uniform(0, 4000, (n, 3)).astype(np.float32)
+        pts_b = (pts_a + np.array([5.0, -3.0, 2.0])
+                 + rng.normal(0, 0.05, pts_a.shape)).astype(np.float32)
+        cand = D.match_candidates(pts_a, pts_b, method=D.RGLDM)
+        assert len(cand) > n // 4

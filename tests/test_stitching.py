@@ -166,3 +166,99 @@ def test_stitching_cli_writes_results(stitch_project):
     for res_ in sd.stitching_results.values():
         assert res_.hash != 0.0
         assert res_.correlation > 0.3
+
+
+class TestNonEqualTransformPath:
+    """Rendered-overlap stitching when linear parts differ
+    (computeStitchingNonEqualTransformations role,
+    SparkPairwiseStitching.java:259-267): one tile registered with a small
+    z-rotation, content generated with a known world translation error —
+    the rendered path must recover that error (VERDICT r3 item 5)."""
+
+    @pytest.fixture(scope="class")
+    def rotated_project(self, tmp_path_factory):
+        from scipy.ndimage import affine_transform
+
+        from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+        from bigstitcher_spark_tpu.io.dataset_io import create_bdv_view_datasets
+        from bigstitcher_spark_tpu.io.spimdata import (
+            AttributeEntity, ImageLoader, SpimData as SD, ViewId, ViewSetup,
+            ViewTransform,
+        )
+        from bigstitcher_spark_tpu.utils.geometry import translation_affine
+        from bigstitcher_spark_tpu.utils.testdata import make_bead_volume
+
+        out = tmp_path_factory.mktemp("rotproj")
+        world, _ = make_bead_volume((120, 96, 40), n_beads=160, seed=21)
+        tile_size = (72, 96, 40)
+        theta = np.deg2rad(3.0)
+        rot = np.array([[np.cos(theta), -np.sin(theta), 0.0],
+                        [np.sin(theta), np.cos(theta), 0.0],
+                        [0.0, 0.0, 1.0]])
+        t_b = np.array([44.0, 0.0, 0.0])
+        err = np.array([2.0, -1.0, 1.0])  # world error baked into B's content
+
+        # view A: identity registration, exact content
+        img_a = world[:tile_size[0], :, :]
+        # view B content sampled at M_B_true(p) = rot @ p + t_b + err
+        img_b = affine_transform(world, rot, offset=t_b + err,
+                                 output_shape=tile_size, order=1)
+        noise = np.random.default_rng(3).normal(0, 4.0, tile_size)
+
+        store = ChunkStore.create(str(out / "dataset.n5"), StorageFormat.N5)
+        sd = SD()
+        sd.image_loader = ImageLoader(format="bdv.n5", path="dataset.n5")
+        sd.timepoints = [0]
+        sd.attributes["illumination"][0] = AttributeEntity(0, "0")
+        sd.attributes["angle"][0] = AttributeEntity(0, "0")
+        sd.attributes["channel"][0] = AttributeEntity(0, "0")
+        for tid in (0, 1):
+            sd.attributes["tile"][tid] = AttributeEntity(tid, str(tid))
+        for sid, img in ((0, img_a), (1, img_b)):
+            sd.setups[sid] = ViewSetup(
+                id=sid, name=f"tile{sid}", size=tile_size,
+                attributes={"illumination": 0, "channel": 0, "tile": sid,
+                            "angle": 0})
+            ds = create_bdv_view_datasets(store, sid, 0, tile_size,
+                                          (32, 32, 16), "uint16")
+            arr = np.clip(img + noise, 0, 65535).astype(np.uint16)
+            ds[0].write(arr, (0, 0, 0))
+        sd.registrations[ViewId(0, 0)] = [
+            ViewTransform("identity", translation_affine((0, 0, 0)))]
+        m_b = np.hstack([rot, t_b.reshape(3, 1)])
+        sd.registrations[ViewId(0, 1)] = [ViewTransform("rigid", m_b)]
+        xml = str(out / "dataset.xml")
+        sd.save(xml)
+        return xml, err
+
+    def test_rendered_path_recovers_known_error(self, rotated_project):
+        xml, err = rotated_project
+        sd = SpimData.load(xml)
+        loader = ViewLoader(sd)
+        from bigstitcher_spark_tpu.models.stitching import _extract_pair_job
+
+        groups = build_groups(sd, sd.view_ids())
+        pairs = plan_pairs(sd, groups)
+        assert len(pairs) == 1
+        job = _extract_pair_job(sd, loader, *pairs[0],
+                                StitchingParams(downsampling=(1, 1, 1)))
+        assert job is not None and job.linear is None, \
+            "rotation must route to the rendered (non-equal-transform) path"
+        results = stitch_all_pairs(sd, loader, sd.view_ids(),
+                                   StitchingParams(downsampling=(1, 1, 1)))
+        assert len(results) == 1
+        res = results[0]
+        assert res.correlation > 0.5
+        # rendered A(w)=W(w), rendered B(w)=W(w+err): expected S = -err
+        # (c_A - c_B convention, same as the equal-transform tests above)
+        np.testing.assert_allclose(res.transform[:, 3], -err, atol=1.0)
+
+    def test_rendered_path_downsampled(self, rotated_project):
+        xml, err = rotated_project
+        sd = SpimData.load(xml)
+        loader = ViewLoader(sd)
+        results = stitch_all_pairs(sd, loader, sd.view_ids(),
+                                   StitchingParams(downsampling=(2, 2, 1)))
+        assert len(results) == 1
+        assert results[0].correlation > 0.5
+        np.testing.assert_allclose(results[0].transform[:, 3], -err, atol=2.0)
