@@ -181,3 +181,100 @@ def split_images_cmd(xml, dry_run, xml_out, target_size, target_overlap,
     out = xml_out or xml
     new_sd.save(out)
     print(f"saved {out}")
+
+
+@click.command()
+@xml_option
+@click.option("-vi", "vi", multiple=True,
+              help="restrict to view ids 'timepoint,setup' (repeatable)")
+@click.option("-l", "--label", "labels", multiple=True,
+              help="restrict to these labels")
+def inspect_interestpoints_cmd(xml, vi, labels):
+    """Print the interestpoints.n5 layout: per (view, label) the point/
+    correspondence datasets, counts, and parameters (debug printer role of
+    SpimData2Util.java:49-162)."""
+    import numpy as np
+
+    from ..io.interestpoints import InterestPointStore, view_group
+    from ..io.spimdata import SpimData, ViewId
+
+    import os
+
+    sd = SpimData.load(xml)
+    root = os.path.join(os.path.dirname(sd.xml_path or "."),
+                        "interestpoints.n5")
+    if not os.path.isdir(root):
+        click.echo(f"no interestpoints store at {root}")
+        return
+    store = InterestPointStore(root)
+    click.echo(f"interestpoints store: {store.root}")
+    views = sorted(sd.interest_points)
+    if vi:
+        want = {ViewId(*(int(x) for x in v.split(","))) for v in vi}
+        views = [v for v in views if v in want]
+    total_p = total_c = 0
+    for v in views:
+        for label, lk in sorted(sd.interest_points.get(v, {}).items()):
+            if labels and label not in labels:
+                continue
+            grp = view_group(v, label)
+            ids, locs = store.load_points(v, label)
+            corrs = store.load_correspondences(v, label)
+            total_p += len(ids)
+            total_c += len(corrs)
+            click.echo(f"{v} label '{label}' ({grp}):")
+            click.echo(f"  interestpoints: {len(ids)} points"
+                       + (f", loc dims {locs.shape[1]}" if len(ids) else ""))
+            if len(ids):
+                mn = np.min(locs, axis=0)
+                mx = np.max(locs, axis=0)
+                click.echo(f"  bounds: {mn.round(1).tolist()} -> "
+                           f"{mx.round(1).tolist()}")
+            if lk.params:
+                click.echo(f"  parameters: {lk.params}")
+            by_other = {}
+            for c in corrs:
+                key = (c.other_view, c.other_label)
+                by_other[key] = by_other.get(key, 0) + 1
+            click.echo(f"  correspondences: {len(corrs)} total")
+            for (ov, ol), n in sorted(by_other.items(),
+                                      key=lambda kv: str(kv[0])):
+                click.echo(f"    -> {ov} '{ol}': {n}")
+    click.echo(f"TOTAL: {total_p} points, {total_c} correspondences "
+               f"in {len(views)} views")
+
+
+@click.command()
+@xml_option
+@infrastructure_options
+@click.option("-xo", "--xmlout", "xml_out", default=None,
+              help="output XML (default: overwrite input)")
+@click.option("--rows", type=int, required=True,
+              help="tile grid row count")
+@click.option("--columns", type=int, required=True,
+              help="tile grid column count")
+@click.option("--parallelRows", "parallel_rows", type=int, default=4,
+              help="rows acquired in parallel (mirror scope sets)")
+def map_setup_ids_cmd(xml, dry_run, xml_out, rows, columns, parallel_rows):
+    """Remap ViewSetup ids to acquisition order for parallel-row mirror
+    scopes (SetupIDMapper.java:36-107: grid ids run bottom-right row-first;
+    acquisition completes every parallelRows-th row right-to-left first)."""
+    from ..io.spimdata import SpimData
+    from ..utils.viewselect import keller_mirror_scope_map
+
+    sd = SpimData.load(xml)
+    mapping = keller_mirror_scope_map(rows, columns, parallel_rows)
+    if set(mapping) != set(sd.setups):
+        raise click.ClickException(
+            f"grid {rows}x{columns} needs setups {min(mapping)}..{max(mapping)}; "
+            f"XML has {sorted(sd.setups)[:3]}..{sorted(sd.setups)[-3:]}")
+    for old in sorted(mapping):
+        click.echo(f"  setup {old} -> {mapping[old]}")
+    if dry_run:
+        return
+    try:
+        sd.remap_setup_ids(mapping)
+    except ValueError as e:
+        raise click.ClickException(str(e)) from e
+    sd.save(xml_out or xml)
+    click.echo(f"remapped {len(mapping)} setups -> {xml_out or xml}")

@@ -9,6 +9,7 @@ attributes on the setup group, plus default mipmap transforms.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Sequence
 
 import numpy as np
@@ -89,21 +90,147 @@ class _CropDataset:
         return self._ds.read(self._off, self.shape)
 
 
+class _ArrayDataset:
+    """In-memory read-only stand-in for a chunked Dataset (TIFF stacks)."""
+
+    def __init__(self, arr: np.ndarray):
+        self._arr = arr
+        self.shape = arr.shape
+        self.dtype = arr.dtype
+
+    def read(self, offset, shape):
+        sel = tuple(slice(int(o), int(o) + int(s))
+                    for o, s in zip(offset, shape))
+        return self._arr[sel]
+
+    def read_full(self):
+        return self._arr
+
+
+class _LazyTiffDataset:
+    """Defers the full-stack decode until pixels are actually read, so
+    metadata probes (.dtype for dataset creation) stay cheap."""
+
+    def __init__(self, tiff: "TiffStackLoader", view, shape):
+        self._tiff = tiff
+        self._view = view
+        self.shape = tuple(int(v) for v in shape)
+
+    @property
+    def dtype(self):
+        return self._tiff.dtype(self._view)
+
+    def read(self, offset, shape):
+        sel = tuple(slice(int(o), int(o) + int(s))
+                    for o, s in zip(offset, shape))
+        return self._tiff.load(self._view)[sel]
+
+    def read_full(self):
+        return self._tiff.load(self._view)
+
+
+class TiffStackLoader:
+    """Legacy TIFF-stack image loader (mvrecon StackImgLoaderIJ family,
+    format ``spimreconstruction*``): one multi-page TIFF per view resolved
+    from a file pattern with ``{t}/{c}/{i}/{a}`` placeholders. This is the
+    input side the reference's resave ingests via bdv imgloaders
+    (SparkResaveN5.java:107-457)."""
+
+    def __init__(self, sd: SpimData, base_dir: str):
+        raw = sd.image_loader.raw
+        if raw is None:
+            raise ValueError("TIFF loader needs the raw ImageLoader XML")
+        txt = lambda tag, d="": (raw.findtext(tag) or d).strip()
+        img_dir = txt("imagedirectory", ".")
+        self.directory = (img_dir if os.path.isabs(img_dir)
+                          else os.path.join(base_dir, img_dir))
+        self.pattern = txt("filePattern")
+        if not self.pattern:
+            raise ValueError("TIFF loader XML has no <filePattern>")
+        self.sd = sd
+        self._cache: dict[str, np.ndarray] = {}
+        self._lock = threading.Lock()
+        self._path_locks: dict[str, threading.Lock] = {}
+
+    def _entity_name(self, attr: str, eid: int) -> str:
+        """Pattern placeholders take the entity NAME (angle degrees, channel
+        wavelengths — StackImgLoaderIJ semantics), not the numeric id."""
+        ent = self.sd.attributes.get(attr, {}).get(eid)
+        return ent.name if ent is not None else str(eid)
+
+    def filename(self, view: ViewId) -> str:
+        s = self.sd.setups[view.setup]
+        name = self.pattern
+        subs = {
+            "{t}": str(view.timepoint),
+            "{c}": self._entity_name("channel", s.attributes.get("channel", 0)),
+            "{i}": self._entity_name("illumination",
+                                     s.attributes.get("illumination", 0)),
+            "{a}": self._entity_name("angle", s.attributes.get("angle", 0)),
+        }
+        for k, v in subs.items():
+            name = name.replace(k, v)
+        return os.path.join(self.directory, name)
+
+    def dtype(self, view: ViewId) -> np.dtype:
+        """Cheap dtype probe: decode only the first page."""
+        path = self.filename(view)
+        with self._lock:
+            if path in self._cache:
+                return self._cache[path].dtype
+        from PIL import Image
+
+        with Image.open(path) as im:
+            return np.asarray(im).dtype
+
+    def load(self, view: ViewId) -> np.ndarray:
+        path = self.filename(view)
+        # one decode per file even under the resave thread pool: a per-path
+        # lock serializes the decode, the global lock guards the dicts
+        with self._lock:
+            if path in self._cache:
+                return self._cache[path]
+            plock = self._path_locks.setdefault(path, threading.Lock())
+        with plock:
+            with self._lock:
+                if path in self._cache:
+                    return self._cache[path]
+            from PIL import Image
+
+            with Image.open(path) as im:
+                pages = []
+                for f in range(getattr(im, "n_frames", 1)):
+                    im.seek(f)
+                    pages.append(np.asarray(im))
+            xyz = np.stack(pages).transpose(2, 1, 0)  # pages: z (y,x) slices
+            with self._lock:
+                if len(self._cache) >= 4:    # bound resident stacks
+                    self._cache.pop(next(iter(self._cache)))
+                self._cache[path] = xyz
+            return xyz
+
+
 class ViewLoader:
     """Opens view images of a SpimData project (bdv.n5 loader equivalent)."""
 
     def __init__(self, spimdata: SpimData):
         self.sd = spimdata
         fmt = spimdata.image_loader.format
-        if fmt not in ("bdv.n5", "bdv.zarr", "bdv.hdf5"):
-            raise NotImplementedError(f"image loader format {fmt!r} not supported yet")
-        root = spimdata.resolve_loader_path()
         self.is_hdf5 = fmt == "bdv.hdf5"
-        if self.is_hdf5:
+        self.is_tiff = fmt.startswith("spimreconstruction")
+        if fmt not in ("bdv.n5", "bdv.zarr", "bdv.hdf5") and not self.is_tiff:
+            raise NotImplementedError(f"image loader format {fmt!r} not supported yet")
+        if self.is_tiff:
+            base = os.path.dirname(spimdata.xml_path or ".")
+            self.store = None
+            self._tiff = TiffStackLoader(spimdata, base)
+        elif self.is_hdf5:
+            root = spimdata.resolve_loader_path()
             if not os.path.exists(root):
                 raise FileNotFoundError(f"image container not found: {root}")
             self.store = Hdf5Store(root, mode="r")
         else:
+            root = spimdata.resolve_loader_path()
             if not uris.has_scheme(root) and not os.path.exists(root):
                 raise FileNotFoundError(f"image container not found: {root}")
             self.store = ChunkStore.open(root)
@@ -116,6 +243,8 @@ class ViewLoader:
         # ids, so resolve against the store directly — no recursion)
         split = self.sd.split_info.get(setup)
         src = split[0] if split is not None else setup
+        if self.is_tiff:
+            return [[1, 1, 1]]
         if src not in self._factors_cache:
             if self.is_hdf5:
                 # BDV-HDF5 keeps per-setup pyramid factors in the
@@ -134,6 +263,14 @@ class ViewLoader:
 
     def _open_raw(self, setup: int, timepoint: int, level: int) -> Dataset:
         key = (setup, timepoint, level)
+        if self.is_tiff:
+            if level != 0:
+                raise ValueError("TIFF stacks have no pyramid levels")
+            # lazy: the stack cache lives in TiffStackLoader (bounded);
+            # don't pin a second unbounded copy here
+            view = ViewId(timepoint, setup)
+            return _LazyTiffDataset(self._tiff, view,
+                                    self.sd.view_size(view))
         if key not in self._cache:
             path = (bdv_hdf5_dataset_path(setup, timepoint, level)
                     if self.is_hdf5

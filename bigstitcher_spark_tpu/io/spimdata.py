@@ -465,6 +465,40 @@ class SpimData:
 
     # ---------------------------------------------------------------- helpers
 
+    def remap_setup_ids(self, mapping: dict[int, int]) -> None:
+        """Renumber ViewSetups (and every per-view table keyed by setup id)
+        by ``mapping`` — acquisition-order remapping
+        (SetupIDMapper.java:36-107). Ids not in the map are kept.
+
+        Must run BEFORE registration artifacts exist: interest points live in
+        interestpoints.n5 groups named by setup id, and stitching results
+        key pairs by ViewId — renumbering under them would silently re-attach
+        data to the wrong physical tiles."""
+        if self.interest_points or self.stitching_results:
+            raise ValueError(
+                "remap_setup_ids must run before detection/stitching: the "
+                "project already has interest points or stitching results "
+                "keyed by the old setup ids (clear them first)")
+        m = lambda s: mapping.get(s, s)
+        import dataclasses
+
+        self.setups = {
+            m(s): dataclasses.replace(vs, id=m(s))
+            for s, vs in self.setups.items()
+        }
+        self.registrations = {
+            ViewId(v.timepoint, m(v.setup)): t
+            for v, t in self.registrations.items()
+        }
+        self.interest_points = {
+            ViewId(v.timepoint, m(v.setup)): t
+            for v, t in self.interest_points.items()
+        }
+        self.missing_views = {
+            ViewId(v.timepoint, m(v.setup)) for v in self.missing_views
+        }
+        self.split_info = {m(s): v for s, v in self.split_info.items()}
+
     def resolve_loader_path(self) -> str:
         from . import uris
 

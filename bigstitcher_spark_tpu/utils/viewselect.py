@@ -100,3 +100,21 @@ def anisotropy_factor_from_voxel_sizes(sd: SpimData, views: list[ViewId]) -> flo
         if vs[0] > 0:
             ratios.append(vs[2] / vs[0])
     return float(np.mean(ratios)) if ratios else 1.0
+
+
+def keller_mirror_scope_map(
+    row_count: int, column_count: int, parallel_rows: int = 4
+) -> dict[int, int]:
+    """Old->new ViewSetup id map for parallel-row mirror-scope acquisitions
+    (SetupIDMapper.java:36-107): grid ids run bottom-right lowest, row-first
+    leftwards then up; acquisition order completes every ``parallel_rows``-th
+    row right-to-left before the next row offset."""
+    mapping: dict[int, int] = {}
+    new_id = 0
+    for row_offset in range(parallel_rows):
+        for col in range(column_count - 1, -1, -1):
+            for row in range(row_offset, row_count, parallel_rows):
+                old_id = row * column_count + (column_count - 1 - col)
+                mapping[old_id] = new_id
+                new_id += 1
+    return mapping

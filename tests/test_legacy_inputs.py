@@ -1,0 +1,172 @@
+"""Legacy TIFF input + niche utilities (closing the last SURVEY §2 rows):
+spimreconstruction TIFF-stack loader feeding resave
+(SparkResaveN5.java:107-457 ingests any bdv imgloader), the
+interestpoints.n5 debug printer (SpimData2Util.java:49-162), and the
+acquisition-order SetupIDMapper (SetupIDMapper.java:36-107).
+"""
+
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from bigstitcher_spark_tpu.cli.main import cli
+from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+from bigstitcher_spark_tpu.io.spimdata import SpimData, ViewId
+
+
+@pytest.fixture(scope="module")
+def tiff_project(tmp_path_factory):
+    """Two-tile project stored as multi-page TIFF stacks + classic
+    spimreconstruction ImageLoader XML."""
+    from PIL import Image
+
+    from bigstitcher_spark_tpu.io.spimdata import (
+        AttributeEntity, ImageLoader, SpimData as SD, ViewSetup, ViewTransform,
+    )
+    from bigstitcher_spark_tpu.utils.geometry import translation_affine
+
+    root = tmp_path_factory.mktemp("tiffproj")
+    size = (40, 32, 10)  # xyz
+    rng = np.random.default_rng(5)
+    stacks = {}
+    # angle NAMES (degrees) differ from ids: the pattern must substitute
+    # the entity name, StackImgLoaderIJ semantics
+    angle_names = {0: "45", 1: "90"}
+    for a in (0, 1):
+        vol = rng.integers(50, 4000, size=size).astype(np.uint16)
+        stacks[a] = vol
+        pages = [Image.fromarray(vol[:, :, z].T) for z in range(size[2])]
+        pages[0].save(str(root / f"spim_TL0_Angle{angle_names[a]}.tif"),
+                      save_all=True, append_images=pages[1:])
+
+    sd = SD()
+    raw = ET.Element("ImageLoader", format="spimreconstruction", version="0.1")
+    ET.SubElement(raw, "imagedirectory", type="relative").text = "."
+    ET.SubElement(raw, "filePattern").text = "spim_TL{t}_Angle{a}.tif"
+    sd.image_loader = ImageLoader(format="spimreconstruction", raw=raw)
+    sd.timepoints = [0]
+    sd.attributes["illumination"][0] = AttributeEntity(0, "0")
+    sd.attributes["channel"][0] = AttributeEntity(0, "0")
+    sd.attributes["tile"][0] = AttributeEntity(0, "0")
+    for a in (0, 1):
+        sd.attributes["angle"][a] = AttributeEntity(a, angle_names[a])
+        sd.setups[a] = ViewSetup(
+            id=a, name=f"angle{a}", size=size,
+            attributes={"illumination": 0, "channel": 0, "tile": 0, "angle": a})
+        sd.registrations[ViewId(0, a)] = [
+            ViewTransform("grid", translation_affine((a * 30.0, 0, 0)))]
+    xml = str(root / "dataset.xml")
+    sd.save(xml)
+    return xml, stacks
+
+
+class TestTiffLoader:
+    def test_reads_stacks(self, tiff_project):
+        xml, stacks = tiff_project
+        sd = SpimData.load(xml)
+        assert sd.image_loader.format == "spimreconstruction"
+        loader = ViewLoader(sd)
+        for a in (0, 1):
+            img = loader.open(ViewId(0, a), 0).read_full()
+            assert (img == stacks[a]).all()
+        # boxed read + halo padding
+        blk = loader.read_block(ViewId(0, 0), 0, (-2, 0, 0), (6, 6, 4))
+        assert (blk[:2] == 0).all() and blk[2:].std() > 0
+
+    def test_resave_from_tiff(self, tiff_project, tmp_path):
+        """resave ingests the TIFF project and rewrites it as bdv.n5 — the
+        reference's legacy-dataset entry point."""
+        xml, stacks = tiff_project
+        out_xml = str(tmp_path / "resaved.xml")
+        r = CliRunner().invoke(cli, [
+            "resave", "-x", xml, "-xo", out_xml,
+            "-o", str(tmp_path / "resaved.n5"), "--N5",
+            "-ds", "1,1,1", "--blockSize", "16,16,8",
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        sd = SpimData.load(out_xml)
+        assert sd.image_loader.format == "bdv.n5"
+        loader = ViewLoader(sd)
+        for a in (0, 1):
+            assert (loader.open(ViewId(0, a), 0).read_full() == stacks[a]).all()
+
+
+class TestInspectInterestpoints:
+    def test_prints_layout(self, tmp_path):
+        from bigstitcher_spark_tpu.io.interestpoints import InterestPointStore
+        from bigstitcher_spark_tpu.io.spimdata import InterestPointLookup
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(2, 1, 1), tile_size=(24, 24, 12),
+            overlap=8, n_beads_per_tile=5)
+        sd = SpimData.load(proj.xml_path)
+        store = InterestPointStore.for_project(sd)
+        v = ViewId(0, 0)
+        pts = np.array([[1.0, 2, 3], [4, 5, 6], [7, 8, 9]])
+        store.save_points(v, "beads", pts, ids=np.arange(3, dtype=np.uint64))
+        sd.interest_points.setdefault(v, {})["beads"] = InterestPointLookup(
+            label="beads", params="DOG test",
+            path="tpId_0_viewSetupId_0/beads")
+        sd.save()
+        r = CliRunner().invoke(cli, ["inspect-interestpoints", "-x",
+                                     proj.xml_path], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        assert "3 points" in r.output
+        assert "beads" in r.output
+        assert "TOTAL: 3 points" in r.output
+
+
+class TestSetupIdMapper:
+    def test_mapping_formula(self):
+        from bigstitcher_spark_tpu.utils.viewselect import keller_mirror_scope_map
+
+        m = keller_mirror_scope_map(8, 3, parallel_rows=4)
+        assert sorted(m) == list(range(24))
+        assert sorted(m.values()) == list(range(24))
+        # first acquired: row 0, rightmost column (col=2) -> old id
+        # row*cols + (cols-1-col) = 0*3 + 0 = 0; then row 4 same column
+        assert m[0] == 0
+        assert m[4 * 3 + 0] == 1
+
+    def test_refuses_after_detection(self, tmp_path):
+        """Remapping after interest points exist would re-attach n5 groups
+        to the wrong tiles — must refuse loudly."""
+        from bigstitcher_spark_tpu.io.spimdata import InterestPointLookup
+
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(2, 1, 1), tile_size=(24, 24, 12),
+            overlap=8, n_beads_per_tile=5)
+        sd = SpimData.load(proj.xml_path)
+        sd.interest_points.setdefault(ViewId(0, 0), {})["beads"] = (
+            InterestPointLookup(label="beads",
+                                path="tpId_0_viewSetupId_0/beads"))
+        with pytest.raises(ValueError, match="before detection"):
+            sd.remap_setup_ids({0: 1, 1: 0})
+
+    def test_cli_remaps_project(self, tmp_path):
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+        from bigstitcher_spark_tpu.utils.viewselect import keller_mirror_scope_map
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(2, 2, 1), tile_size=(24, 24, 12),
+            overlap=8, n_beads_per_tile=5)
+        out_xml = str(tmp_path / "remapped.xml")
+        r = CliRunner().invoke(cli, [
+            "map-setup-ids", "-x", proj.xml_path, "-xo", out_xml,
+            "--rows", "2", "--columns", "2", "--parallelRows", "1",
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        sd0 = SpimData.load(proj.xml_path)
+        sd = SpimData.load(out_xml)
+        mapping = keller_mirror_scope_map(2, 2, 1)
+        assert sorted(sd.setups) == sorted(sd0.setups)
+        for old, new in mapping.items():
+            assert sd.setups[new].name == sd0.setups[old].name
+            np.testing.assert_array_equal(
+                sd.model(ViewId(0, new)), sd0.model(ViewId(0, old)))
