@@ -253,7 +253,8 @@ def _np_phasecorr_pair(a, b, n_peaks=5, min_overlap=32.0):
     fa = np.fft.rfftn(pa)
     fb = np.fft.rfftn(pb)
     cross = fa * np.conj(fb)
-    pcm = np.fft.irfftn(cross / np.maximum(np.abs(cross), 1e-10), s=shp)
+    pcm = np.fft.irfftn(cross / np.maximum(np.abs(cross), 1e-10), s=shp,
+                        axes=tuple(range(len(shp))))
     loc = (pcm == maximum_filter(pcm, size=3, mode="wrap"))
     flat = np.where(loc.ravel(), pcm.ravel(), -np.inf)
     top = np.argsort(flat)[-n_peaks:][::-1]

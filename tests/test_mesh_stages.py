@@ -47,8 +47,12 @@ def test_detection_sharded_equals_single(project):
     assert sum(len(d.points) for d in multi) > 0
     for dm, ds in zip(multi, single):
         assert dm.view == ds.view
-        np.testing.assert_array_equal(dm.points, ds.points)
-        np.testing.assert_array_equal(dm.values, ds.values)
+        # sharded and unsharded compilations tile the blur GEMMs
+        # differently -> f32 accumulation-order noise (SURVEY §7: tolerance,
+        # not bit-exactness, for float comparisons)
+        np.testing.assert_allclose(dm.points, ds.points, atol=1e-4)
+        np.testing.assert_allclose(dm.values, ds.values, rtol=1e-4,
+                                   atol=1e-7)
 
 
 def _make_volume_dataset(tmp_path, name, seed):
