@@ -76,7 +76,7 @@ _DTYPES = ("UINT8", "UINT16", "FLOAT32")
 @click.option("--boundingBox", "bounding_box", default=None,
               help="use a named bounding box from the XML instead of the maximal one")
 @click.option("--compression", default="zstd",
-              type=click.Choice(["zstd", "gzip", "raw", "blosc"]))
+              type=click.Choice(["zstd", "gzip", "raw", "blosc", "bzip2", "xz"]))
 def create_fusion_container_cmd(xml, output, storage, data_type, block_size,
                                 bdv, xml_out, multi_res, downsampling,
                                 preserve_anisotropy, anisotropy_factor,
@@ -86,6 +86,9 @@ def create_fusion_container_cmd(xml, output, storage, data_type, block_size,
     sd = SpimData.load(xml)
     views = select_views_from_kwargs(sd, kwargs)
     storage_format = StorageFormat(storage)
+    if compression == "xz" and storage_format != StorageFormat.N5:
+        raise click.ClickException(
+            "xz compression is only available for N5 containers")
 
     channels = sorted({sd.setups[v.setup].attributes.get("channel", 0) for v in views})
     tps = sorted({v.timepoint for v in views})

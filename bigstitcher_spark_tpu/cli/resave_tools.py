@@ -64,7 +64,7 @@ def parse_pyramid(spec_list) -> list[list[int]] | None:
 @click.option("-ds", "--downsampling", "downsampling", multiple=True,
               help="pyramid steps incl. 1,1,1, e.g. '1,1,1; 2,2,1; 4,4,1'")
 @click.option("-c", "--compression", default="zstd",
-              type=click.Choice(["zstd", "gzip", "raw", "blosc"]))
+              type=click.Choice(["zstd", "gzip", "raw", "blosc", "bzip2", "xz"]))
 @click.option("--threads", type=int, default=8,
               help="host IO threads for block copy")
 def resave_cmd(xml, xml_out, out_path, as_n5, block_size, block_scale,
@@ -74,6 +74,9 @@ def resave_cmd(xml, xml_out, out_path, as_n5, block_size, block_scale,
     loader = ViewLoader(sd)
     views = select_views_from_kwargs(sd, kwargs)
     storage_format = StorageFormat.N5 if as_n5 else StorageFormat.ZARR
+    if compression == "xz" and storage_format != StorageFormat.N5:
+        raise click.ClickException(
+            "xz compression is only available for N5 containers (--N5)")
     if out_path is None:
         ext = "n5" if as_n5 else "zarr"
         out_path = os.path.join(os.path.dirname(os.path.abspath(xml)),

@@ -59,6 +59,8 @@ _ZARR_DTYPE = {
 
 
 def _n5_compression(name: str) -> dict:
+    """N5 codec factory (reference surface: Lz4/Gzip/Zstd/Blosc/Bzip2/Xz/Raw,
+    util/N5Util.java:82-105; lz4 has no tensorstore n5 codec)."""
     name = name.lower()
     if name == "zstd":
         return {"type": "zstd"}
@@ -68,6 +70,10 @@ def _n5_compression(name: str) -> dict:
         return {"type": "raw"}
     if name == "blosc":
         return {"type": "blosc", "cname": "zstd", "clevel": 3, "shuffle": 1}
+    if name == "bzip2":
+        return {"type": "bzip2"}
+    if name == "xz":
+        return {"type": "xz"}
     raise ValueError(f"unsupported n5 compression: {name}")
 
 
@@ -79,6 +85,8 @@ def _zarr_compressor(name: str) -> dict | None:
         return {"id": "zlib", "level": 5}
     if name == "blosc":
         return {"id": "blosc", "cname": "zstd", "clevel": 3, "shuffle": 1}
+    if name == "bzip2":
+        return {"id": "bz2", "level": 5}
     if name == "raw":
         return None
     raise ValueError(f"unsupported zarr compression: {name}")

@@ -179,6 +179,31 @@ def fuse_grid_block(
     return fused[sl], wsum[sl]
 
 
+def build_coefficient_arrays(sd, loader, plans, coefficients, nb):
+    """(nb, Cx,Cy,Cz, 2) grid stack + (nb, 3, 4) lpos->grid affines for the
+    first ``len(plans)`` slots (identity scale for missing/padded views).
+    Shared by the composite and per-block gather paths so the coordinate
+    convention cannot diverge: level coords -> grid coords with full-res
+    px = f*l + (f-1)/2 and cell centers at (k+0.5)*cs - 0.5,
+    cs = view_size/dims (BlkAffineFusion coefficients semantics)."""
+    cdims = next(iter(coefficients.values())).shape[:3]
+    coeffs = np.zeros((nb, *cdims, 2), np.float32)
+    coeffs[..., 0] = 1.0
+    coeff_affs = np.zeros((nb, 3, 4), np.float32)
+    coeff_affs[:, :, :3] = np.eye(3)
+    for i, p in enumerate(plans):
+        grid = coefficients.get(p.view)
+        if grid is None:
+            continue
+        coeffs[i] = grid
+        f = np.asarray(loader.downsampling_factors(p.view.setup)[p.level],
+                       np.float64)
+        cs = np.array(sd.view_size(p.view), np.float64) / np.array(cdims)
+        coeff_affs[i, :, :3] = np.diag(f / cs)
+        coeff_affs[i, :, 3] = ((f - 1) / 2.0 + 0.5) / cs - 0.5
+    return coeffs, coeff_affs
+
+
 def _gather_inputs(sd, loader, plans, pshape, vb, blend, inside_offset,
                    coefficients):
     """Host-side input staging for the general gather kernel: prefetch the
@@ -205,23 +230,8 @@ def _gather_inputs(sd, loader, plans, pshape, vb, blend, inside_offset,
 
     coeffs = coeff_affs = None
     if coefficients is not None:
-        cdims = next(iter(coefficients.values())).shape[:3]
-        coeffs = np.zeros((vb, *cdims, 2), np.float32)
-        coeffs[..., 0] = 1.0
-        coeff_affs = np.zeros((vb, 3, 4), np.float32)
-        coeff_affs[:, :, :3] = np.eye(3)
-        for i, p in enumerate(plans):
-            grid = coefficients.get(p.view)
-            if grid is None:
-                continue
-            coeffs[i] = grid
-            # level coords -> grid coords: full-res px = f*l + (f-1)/2; cell
-            # centers at (k+0.5)*cs - 0.5 with cs = view_size/dims
-            f = np.asarray(loader.downsampling_factors(p.view.setup)[p.level],
-                           np.float64)
-            cs = np.array(sd.view_size(p.view), np.float64) / np.array(cdims)
-            coeff_affs[i, :, :3] = np.diag(f / cs)
-            coeff_affs[i, :, 3] = ((f - 1) / 2.0 + 0.5) / cs - 0.5
+        coeffs, coeff_affs = build_coefficient_arrays(
+            sd, loader, plans, coefficients, vb)
     ioffs = np.tile(np.asarray(inside_offset, np.float32), (vb, 1))
     return (patches, affines, offsets, img_dims, borders, ranges, valid,
             ioffs, coeffs, coeff_affs)
@@ -298,11 +308,13 @@ class CompositePlan:
     borders: np.ndarray
     ranges: np.ndarray
     inside_offs: np.ndarray
+    coeffs: np.ndarray | None = None       # (V, Cx,Cy,Cz, 2) intensity maps
+    coeff_affs: np.ndarray | None = None   # (V, 3, 4) diagonal lpos->grid
 
 
 def plan_composite_volume(
     sd, loader, views, bbox, anisotropy, blend, masks=False,
-    mask_offset=(0.0, 0.0, 0.0),
+    mask_offset=(0.0, 0.0, 0.0), coefficients=None,
 ) -> CompositePlan | None:
     """Plan the composite device path. None when a view is not a pure
     translation at stored level 0 or the tile stack exceeds the budget."""
@@ -350,8 +362,13 @@ def plan_composite_volume(
         factors = loader.downsampling_factors(p.view.setup)[p.level]
         borders[i] = np.asarray(blend.border) / np.asarray(factors)
         ranges[i] = np.asarray(blend.range) / np.asarray(factors)
+    coeffs = coeff_affs = None
+    if coefficients is not None:
+        coeffs, coeff_affs = build_coefficient_arrays(
+            sd, loader, plans, coefficients, len(plans))
     return CompositePlan(plans, out_shape, tuple(windows), tuple(n_offs),
-                         pad, fracs, img_dims, borders, ranges, inside_offs)
+                         pad, fracs, img_dims, borders, ranges, inside_offs,
+                         coeffs, coeff_affs)
 
 
 def upload_composite_tiles(loader, cp: CompositePlan) -> list:
@@ -367,18 +384,21 @@ def dispatch_composite(cp: CompositePlan, tiles, fusion_type, out_dtype,
                        masks, min_intensity, max_intensity):
     """Run the compiled composite program; returns the device-resident
     converted output (does not block)."""
+    with_coeffs = cp.coeffs is not None
     fuser = F.make_translation_composite(
         cp.out_shape, cp.windows, cp.n_offs, pad=cp.pad,
-        fusion_type=fusion_type, out_dtype=out_dtype, masks=masks)
+        fusion_type=fusion_type, out_dtype=out_dtype, masks=masks,
+        with_coeffs=with_coeffs)
+    extra = (cp.coeffs, cp.coeff_affs) if with_coeffs else ()
     return fuser(tiles, cp.fracs, cp.img_dims, cp.borders, cp.ranges,
                  cp.inside_offs, np.float32(min_intensity),
-                 np.float32(max_intensity))
+                 np.float32(max_intensity), *extra)
 
 
 def _try_fuse_volume_device(
     sd, loader, views, bbox, fusion_type, blend,
     anisotropy, out_dtype, min_intensity, max_intensity, masks, stats,
-    mask_offset=(0.0, 0.0, 0.0),
+    mask_offset=(0.0, 0.0, 0.0), coefficients=None,
 ):
     """Whole-volume device-resident fusion via the static composite kernel
     (ops.fusion.make_translation_composite): per-view static output windows,
@@ -390,7 +410,7 @@ def _try_fuse_volume_device(
     DEVICE array (converted to out_dtype) ready for pipelined D2H via
     _drain_device_volume, or None to fall back to the per-block path."""
     cp = plan_composite_volume(sd, loader, views, bbox, anisotropy, blend,
-                               masks, mask_offset)
+                               masks, mask_offset, coefficients)
     if cp is None:
         return None
     tiles = upload_composite_tiles(loader, cp)
@@ -540,10 +560,14 @@ def _fuse_volume_sharded(
                 _write_block(out_ds, data[sl], block, zarr_ct)
                 written[tuple(block.offset)] = int(np.prod(block.size))
 
+            # pack several blocks per device per batch: fusion dispatches are
+            # compute-light, so fewer+bigger launches amortize dispatch and
+            # keep the host IO pipeline ahead (VERDICT r3 item 1b)
+            per_dev = max(1, min(4, len(items) // max(n_dev, 1)))
             run_sharded_batches(
                 items, build, kernel_call, consume, n_dev, pool,
                 label=f"fusion batch {key}", progress=progress,
-                multihost=True,
+                multihost=True, per_dev=per_dev,
             )
             stats.voxels += sum(written.values())
     finally:
@@ -609,11 +633,12 @@ def fuse_volume(
         return stats
 
     use_composite = device_resident is not False
-    vol = None if (coefficients is not None or not use_composite) else (
+    vol = None if not use_composite else (
         _try_fuse_volume_device(
             sd, loader, views, bbox, fusion_type,
             blend or BlendParams(), aniso, out_dtype, min_intensity,
             max_intensity, masks, stats, mask_offset=mask_offset,
+            coefficients=coefficients,
         ))
     if vol is not None:
         _drain_device_volume(vol, out_ds, zarr_ct, io_threads=io_threads)

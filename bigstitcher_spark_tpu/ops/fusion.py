@@ -411,6 +411,20 @@ def _composite_one_view(P, frac, img_dim, border, blend_range, inside_off,
     return val, inside, blend
 
 
+def _separable_interp_matrix(pos, c: int):
+    """(L, c) linear-interpolation matrix for 1-D grid coords ``pos`` (L,),
+    edge-clamped: row i holds weights (1-f) at floor(pos_i), f at floor+1.
+    Trilinear interpolation of a regular grid at separable coordinates is
+    the tensor product of three of these (exact, no gathers)."""
+    p = jnp.clip(pos, 0.0, float(c - 1))
+    lo = jnp.clip(jnp.floor(p), 0, max(c - 2, 0)).astype(jnp.int32)
+    f = p - lo
+    cols = jnp.arange(c, dtype=jnp.int32)[None, :]
+    return (jnp.where(cols == lo[:, None], 1.0 - f[:, None], 0.0)
+            + jnp.where(cols == jnp.minimum(lo + 1, c - 1)[:, None],
+                        f[:, None], 0.0))
+
+
 @functools.lru_cache(maxsize=32)
 def make_translation_composite(
     out_shape: tuple[int, int, int],
@@ -420,15 +434,21 @@ def make_translation_composite(
     fusion_type: str = "AVG_BLEND",
     out_dtype: str = "float32",
     masks: bool = False,
+    with_coeffs: bool = False,
 ):
     """Build + jit the composite fusion program for one volume layout.
 
     Returned fn(tiles, fracs, img_dims, borders, ranges, inside_offs,
-    min_i, max_i) -> converted output of ``out_shape``. ``tiles`` is a list
-    of raw (unpadded) per-view tiles (any integer/float dtype)."""
+    min_i, max_i[, coeffs, coeff_affs]) -> converted output of
+    ``out_shape``. ``tiles`` is a list of raw (unpadded) per-view tiles (any
+    integer/float dtype). With ``with_coeffs``, per-view (Cx,Cy,Cz,2)
+    intensity grids [scale, offset] are applied inside the kernel —
+    trilinear over the window via separable interpolation matrices
+    (BlkAffineFusion.initWithIntensityCoefficients role)."""
     V = len(windows)
 
-    def impl(tiles, fracs, img_dims, borders, ranges, inside_offs, min_i, max_i):
+    def impl(tiles, fracs, img_dims, borders, ranges, inside_offs, min_i,
+             max_i, coeffs=None, coeff_affs=None):
         if fusion_type == "MAX_INTENSITY":
             acc = jnp.full(out_shape, -jnp.inf, jnp.float32)
         else:
@@ -445,6 +465,21 @@ def make_translation_composite(
             val, inside, blend = _composite_one_view(
                 P, fracs[v], img_dims[v], borders[v], ranges[v],
                 inside_offs[v], a, L, n, pad)
+            if with_coeffs:
+                # lpos over the window is separable; grid coords through the
+                # diagonal coeff affine stay separable -> trilinear of the
+                # (Cx,Cy,Cz,2) grid = 3 small tensordots, no gathers.
+                # Each step contracts the leading C axis and appends L_d;
+                # after 3 steps the layout is (2, L0, L1, L2).
+                so = coeffs[v]
+                for d in range(3):
+                    lpos_d = ((a[d] + n[d])
+                              + jnp.arange(L[d], dtype=jnp.float32)
+                              + fracs[v][d])
+                    gc = lpos_d * coeff_affs[v][d, d] + coeff_affs[v][d, 3]
+                    m = _separable_interp_matrix(gc, so.shape[0])
+                    so = jnp.tensordot(so, m, axes=[[0], [1]])
+                val = so[0] * val + so[1]
             win = tuple(slice(a[d], b[d]) for d in range(3))
             if fusion_type == "AVG":
                 w = inside

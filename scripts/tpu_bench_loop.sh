@@ -2,7 +2,7 @@
 # Patient TPU bench capture: retry the axon tunnel for hours (VERDICT r2 #1:
 # "stop treating the bench as an end-of-round event"). Probes cheaply; when
 # the tunnel answers, runs the full bench and saves the artifact to
-# BENCH_TPU_r03.json + the raw log. Does NOT git-commit (the operator does).
+# BENCH_TPU_r04.json + the raw log. Does NOT git-commit (the operator does).
 set -u
 cd /root/repo
 ATTEMPTS=${1:-150}
@@ -14,10 +14,10 @@ for i in $(seq 1 "$ATTEMPTS"); do
       if grep -q '"platform": "cpu"' /tmp/bench_tpu_out.json; then
         echo "[loop $(date +%T)] bench fell back to cpu; retrying later"
       else
-        cp /tmp/bench_tpu_out.json BENCH_TPU_r03.json
-        cp /tmp/bench_tpu_err.log BENCH_TPU_r03.log
+        cp /tmp/bench_tpu_out.json BENCH_TPU_r04.json
+        cp /tmp/bench_tpu_err.log BENCH_TPU_r04.log
         echo "[loop $(date +%T)] TPU BENCH CAPTURED:"
-        cat BENCH_TPU_r03.json
+        cat BENCH_TPU_r04.json
         exit 0
       fi
     else

@@ -84,6 +84,31 @@ def test_composite_masks_with_mask_offset(project, tmp_path):
     assert (comp > 0).sum() >= (plain > 0).sum()
 
 
+def test_composite_intensity_coefficients(project, tmp_path):
+    """Per-view intensity-correction grids applied inside the composite
+    kernel (separable trilinear) agree with the per-block gather path
+    (BlkAffineFusion.initWithIntensityCoefficients role)."""
+    from bigstitcher_spark_tpu.io.spimdata import SpimData
+
+    sd = SpimData.load(project.xml_path)
+    rng = np.random.default_rng(5)
+    coeffs = {}
+    for v in sd.view_ids():
+        g = np.ones((2, 2, 2, 2), np.float32)
+        g[..., 0] = rng.uniform(0.8, 1.2, (2, 2, 2))   # scale
+        g[..., 1] = rng.uniform(-30.0, 30.0, (2, 2, 2))  # offset
+        coeffs[v] = g
+    comp, st = _fuse(project, tmp_path, "ic_comp", devices=1,
+                     coefficients=coeffs)
+    assert any("composite" in str(k) for k in st.compile_keys), \
+        "coefficient fusion should take the composite device path"
+    blockwise, _ = _fuse(project, tmp_path, "ic_pb", devices=1,
+                         device_resident=False, coefficients=coeffs)
+    assert comp.std() > 0
+    diff = np.abs(comp.astype(np.int64) - blockwise.astype(np.int64))
+    assert diff.max() <= 1  # f32 rounding at accumulation-order boundaries
+
+
 def test_sharded_device_composite_agrees(project, tmp_path):
     """The single-device whole-volume composite path and the sharded
     per-block path agree (same math, different dispatch)."""
