@@ -496,6 +496,11 @@ def _fuse_volume_sharded(
     grid = create_grid(bbox.shape, compute_block, compute_block)
     inside_offset = mask_offset if masks else (0.0, 0.0, 0.0)
 
+    # multi-host: slice the grid BEFORE bucketing so batching heuristics
+    # (per_dev) see this process's actual work list
+    from ..parallel.distributed import partition_items
+
+    grid = partition_items(grid)
     planned = []
     for block in grid:
         bg = Interval.from_shape(compute_block, block.offset).translate(bbox.min)
@@ -560,14 +565,24 @@ def _fuse_volume_sharded(
                 _write_block(out_ds, data[sl], block, zarr_ct)
                 written[tuple(block.offset)] = int(np.prod(block.size))
 
-            # pack several blocks per device per batch: fusion dispatches are
-            # compute-light, so fewer+bigger launches amortize dispatch and
-            # keep the host IO pipeline ahead (VERDICT r3 item 1b)
-            per_dev = max(1, min(4, len(items) // max(n_dev, 1)))
+            # pack several blocks per device per batch: fusion dispatches
+            # are compute-light, so fewer+bigger launches amortize dispatch
+            # and keep the host IO pipeline ahead (VERDICT r3 item 1b) — but
+            # bounded by a per-device staging budget so configurations that
+            # fit at per_dev=1 cannot OOM
+            if kernel == "shift":
+                item_bytes = vb * int(np.prod(
+                    [c + 1 for c in compute_block])) * 4
+            else:
+                item_bytes = vb * int(np.prod(key[1])) * 4
+            budget = int(float(__import__("os").environ.get(
+                "BST_PER_DEV_BUDGET", 1e9)))
+            per_dev = max(1, min(4, len(items) // max(n_dev, 1),
+                                 budget // max(item_bytes, 1)))
             run_sharded_batches(
                 items, build, kernel_call, consume, n_dev, pool,
                 label=f"fusion batch {key}", progress=progress,
-                multihost=True, per_dev=per_dev,
+                per_dev=per_dev,
             )
             stats.voxels += sum(written.values())
     finally:

@@ -246,3 +246,43 @@ def test_cli_detect(tmp_path):
     store = InterestPointStore.for_project(sd)
     ids, locs = store.load_points(ViewId(0, 0), "beads")
     assert len(ids) > 5
+
+
+def test_topk_truncation_warns_and_keeps_strongest(tmp_path):
+    """When a block holds more extrema than the device compaction budget,
+    the K strongest survive and a warning reports the truncation."""
+    import warnings
+
+    from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+    from bigstitcher_spark_tpu.io.spimdata import SpimData
+    from bigstitcher_spark_tpu.models.detection import (
+        DetectionParams, detect_interest_points,
+    )
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    proj = make_synthetic_project(
+        str(tmp_path / "proj"), n_tiles=(1, 1, 1), tile_size=(64, 64, 32),
+        overlap=8, n_beads_per_tile=25, seed=11)
+    sd = SpimData.load(proj.xml_path)
+    loader = ViewLoader(sd)
+    params_full = DetectionParams(downsample_xy=1, downsample_z=1,
+                                  block_size=(64, 64, 32))
+    full = detect_interest_points(sd, loader, sd.view_ids(), params_full,
+                                  progress=False)
+    n_full = len(full[0].points)
+    k = 4
+    assert n_full >= 2 * k, "fixture must over-fill the truncation budget"
+    params_small = DetectionParams(downsample_xy=1, downsample_z=1,
+                                   block_size=(64, 64, 32),
+                                   max_candidates_per_block=k)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        trunc = detect_interest_points(sd, loader, sd.view_ids(),
+                                       params_small, progress=False)
+    assert any("strongest" in str(x.message) for x in w)
+    assert len(trunc[0].points) == k
+    # the kept spots are among the strongest of the full set (selection is
+    # by |raw response| BEFORE subpixel refinement, so exact rank can shift
+    # within near-ties)
+    cutoff = np.sort(np.abs(full[0].values))[-(2 * k):][0]
+    assert (np.abs(trunc[0].values) >= cutoff * 0.98).all()
