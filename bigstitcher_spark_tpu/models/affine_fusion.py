@@ -328,6 +328,10 @@ def plan_composite_volume(
     itemsizes = [np.dtype(loader.open(p.view, 0).dtype).itemsize
                  for p in plans]
     nbytes = sum(int(np.prod(s)) * isz for s, isz in zip(shapes, itemsizes))
+    # device residency: tiles + the kernel's full-volume f32 accumulators
+    # (acc + wsum + converted output ~= 3x) must fit the budget, or the
+    # caller falls back to the per-block path (fuse_grid_block loop)
+    nbytes += 3 * int(np.prod(bbox.shape)) * 4
     if nbytes > DEVICE_TILE_BUDGET_BYTES:
         return None
 
