@@ -262,3 +262,42 @@ class TestNonEqualTransformPath:
         assert len(results) == 1
         assert results[0].correlation > 0.5
         np.testing.assert_allclose(results[0].transform[:, 3], -err, atol=2.0)
+
+
+class TestResultFilters:
+    """Link filters (FilteredStitchingResults: Correlation, AbsoluteShift,
+    ShiftMagnitude — SparkPairwiseStitching.java:347-382)."""
+
+    @staticmethod
+    def _mk(shift, r):
+        from bigstitcher_spark_tpu.io.spimdata import (
+            PairwiseStitchingResult, ViewId,
+        )
+        from bigstitcher_spark_tpu.utils.geometry import translation_affine
+
+        return PairwiseStitchingResult(
+            views_a=(ViewId(0, 0),), views_b=(ViewId(0, 1),),
+            transform=translation_affine(shift), correlation=r, hash="h")
+
+    def test_min_r_filter(self):
+        from bigstitcher_spark_tpu.models.stitching import (
+            StitchingParams, filter_results,
+        )
+
+        res = [self._mk((1, 0, 0), 0.9), self._mk((2, 0, 0), 0.2)]
+        kept = filter_results(res, StitchingParams(min_r=0.5))
+        assert len(kept) == 1 and kept[0].correlation == 0.9
+
+    def test_max_shift_filters(self):
+        from bigstitcher_spark_tpu.models.stitching import (
+            StitchingParams, filter_results,
+        )
+
+        res = [self._mk((1.0, 1.0, 0.0), 0.9),
+               self._mk((30.0, 0.0, 0.0), 0.9),   # per-axis violation
+               self._mk((8.0, 8.0, 8.0), 0.9)]    # magnitude violation
+        kept = filter_results(
+            res, StitchingParams(max_shift=(10.0, 10.0, 10.0),
+                                 max_shift_total=12.0))
+        assert len(kept) == 1
+        assert tuple(kept[0].transform[:, 3]) == (1.0, 1.0, 0.0)
