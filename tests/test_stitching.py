@@ -277,25 +277,21 @@ class TestResultFilters:
 
         return PairwiseStitchingResult(
             views_a=(ViewId(0, 0),), views_b=(ViewId(0, 1),),
-            transform=translation_affine(shift), correlation=r, hash="h")
+            transform=translation_affine(shift), correlation=r, hash=0.5)
 
     def test_min_r_filter(self):
-        from bigstitcher_spark_tpu.models.stitching import (
-            StitchingParams, filter_results,
-        )
+        from bigstitcher_spark_tpu.models.stitching import filter_results
 
         res = [self._mk((1, 0, 0), 0.9), self._mk((2, 0, 0), 0.2)]
         kept = filter_results(res, StitchingParams(min_r=0.5))
         assert len(kept) == 1 and kept[0].correlation == 0.9
 
     def test_max_shift_filters(self):
-        from bigstitcher_spark_tpu.models.stitching import (
-            StitchingParams, filter_results,
-        )
+        from bigstitcher_spark_tpu.models.stitching import filter_results
 
         res = [self._mk((1.0, 1.0, 0.0), 0.9),
-               self._mk((30.0, 0.0, 0.0), 0.9),   # per-axis violation
-               self._mk((8.0, 8.0, 8.0), 0.9)]    # magnitude violation
+               self._mk((11.0, 0.0, 0.0), 0.9),  # per-axis only (norm 11 < 12)
+               self._mk((8.0, 8.0, 8.0), 0.9)]   # magnitude only (8*sqrt3 > 12)
         kept = filter_results(
             res, StitchingParams(max_shift=(10.0, 10.0, 10.0),
                                  max_shift_total=12.0))
