@@ -112,6 +112,9 @@ def detect_interestpoints_cmd(xml, dry_run, **kw):
 @click.option("--ransacMaxEpsilon", default=5.0, type=float)
 @click.option("--ransacMinInlierRatio", default=0.1, type=float)
 @click.option("--ransacMinNumInliers", default=12, type=int)
+@click.option("-rmc", "--ransacMultiConsensus", "ransac_multi", is_flag=True,
+              default=False,
+              help="ransac performs multiconsensus matching")
 @click.option("--icpMaxDistance", default=2.5, type=float)
 @click.option("--icpMaxIterations", default=200, type=int)
 @click.option("--interestPointsForOverlapOnly", "overlap_only_points",
@@ -149,6 +152,7 @@ def match_interestpoints_cmd(xml, dry_run, **kw):
         ransac_max_epsilon=kw["ransacmaxepsilon"],
         ransac_min_inlier_ratio=kw["ransacmininlierratio"],
         ransac_min_inliers=kw["ransacminnuminliers"],
+        ransac_multi_consensus=kw["ransac_multi"],
         icp_max_distance=kw["icpmaxdistance"],
         icp_max_iterations=kw["icpmaxiterations"],
         registration_tp=kw["registration_tp"],

@@ -54,6 +54,7 @@ class MatchingParams:
     ransac_max_epsilon: float = 5.0
     ransac_min_inlier_ratio: float = 0.1
     ransac_min_inliers: int = 12
+    ransac_multi_consensus: bool = False  # --ransacMultiConsensus (-rmc)
     icp_max_distance: float = 2.5
     icp_max_iterations: int = 200
     registration_tp: str = INDIVIDUAL_TIMEPOINTS
@@ -157,6 +158,21 @@ def match_pair(
     )
     if len(cand) == 0:
         return np.zeros((0, 2), np.int32), None, 0
+    if params.ransac_multi_consensus:
+        sets = D.ransac_multi(
+            wa[cand[:, 0]], wb[cand[:, 1]],
+            params.model, params.regularization, params.lam,
+            params.ransac_max_epsilon, params.ransac_min_inlier_ratio,
+            params.ransac_min_inliers, params.ransac_iterations, seed=seed,
+        )
+        if not sets:
+            return np.zeros((0, 2), np.int32), None, len(cand)
+        union = np.zeros(len(cand), bool)
+        for _, mask in sets:
+            union |= mask
+        # the dominant model represents the pair; correspondences keep
+        # every consensus set (reference multiconsensus semantics)
+        return cand[union], sets[0][0], len(cand)
     res = D.ransac(
         wa[cand[:, 0]], wb[cand[:, 1]],
         params.model, params.regularization, params.lam,

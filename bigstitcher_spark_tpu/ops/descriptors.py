@@ -390,6 +390,46 @@ def ransac(
     return np.asarray(model, np.float64), inliers
 
 
+def ransac_multi(
+    cand_a: np.ndarray,
+    cand_b: np.ndarray,
+    model_kind: str = "AFFINE",
+    reg_kind: str = "RIGID",
+    lam: float = 0.1,
+    epsilon: float = 5.0,
+    min_inlier_ratio: float = 0.1,
+    min_inliers: int = 12,
+    iterations: int = 10000,
+    seed: int = 17,
+) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Multi-consensus RANSAC (RANSACParameters multiconsensus option,
+    SparkGeometricDescriptorMatching.java:145-146,307): repeatedly find the
+    largest consensus among the REMAINING candidates, remove its inliers,
+    and continue until no consensus is left (the reference's loop
+    semantics) — so a pair whose correspondences follow several distinct
+    transforms (e.g. grouped tiles moving independently) yields every set.
+
+    Returns [(model 3x4, inlier_mask over the ORIGINAL candidates), ...]
+    ordered by discovery (largest consensus first in practice). Terminates:
+    every accepted set removes >= min_inliers >= 1 candidates."""
+    remaining = np.arange(len(cand_a))
+    out: list[tuple[np.ndarray, np.ndarray]] = []
+    round_i = 0
+    while len(remaining) >= max(min_inliers, 1):
+        res = ransac(cand_a[remaining], cand_b[remaining], model_kind,
+                     reg_kind, lam, epsilon, min_inlier_ratio, min_inliers,
+                     iterations, seed=seed + round_i)
+        if res is None:
+            break
+        model, inl = res
+        mask = np.zeros(len(cand_a), bool)
+        mask[remaining[inl]] = True
+        out.append((model, mask))
+        remaining = remaining[~inl]
+        round_i += 1
+    return out
+
+
 # --------------------------------------------------------------------------
 # ICP
 # --------------------------------------------------------------------------

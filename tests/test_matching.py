@@ -461,3 +461,63 @@ class TestTiledMatching:
                  + rng.normal(0, 0.05, pts_a.shape)).astype(np.float32)
         cand = D.match_candidates(pts_a, pts_b, method=D.RGLDM)
         assert len(cand) > n // 4
+
+
+class TestMultiConsensusRansac:
+    """--ransacMultiConsensus (-rmc): a pair whose correspondences follow
+    TWO distinct transforms yields both consensus sets
+    (RANSACParameters multiconsensus, SparkGeometricDescriptorMatching.java:145-146)."""
+
+    def test_two_translations_both_found(self):
+        from bigstitcher_spark_tpu.ops.descriptors import ransac, ransac_multi
+
+        rng = np.random.default_rng(8)
+        a1 = rng.uniform(0, 150, (60, 3))
+        a2 = rng.uniform(0, 150, (60, 3))
+        t1 = np.array([5.0, -2.0, 1.0])
+        t2 = np.array([-12.0, 7.0, -4.0])
+        cand_a = np.concatenate([a1, a2])
+        cand_b = np.concatenate([a1 + t1, a2 + t2])
+        noise = rng.normal(0, 0.2, cand_b.shape)
+        cand_b = cand_b + noise
+
+        single = ransac(cand_a, cand_b, "TRANSLATION", "NONE", 0.0,
+                        epsilon=3.0, iterations=2000)
+        assert single is not None
+        _, inl = single
+        assert inl.sum() <= 65  # single consensus captures only one cluster
+
+        sets = ransac_multi(cand_a, cand_b, "TRANSLATION", "NONE", 0.0,
+                            epsilon=3.0, iterations=2000)
+        assert len(sets) == 2
+        found = sorted(tuple(np.round(m[:, 3]).astype(int)) for m, _ in sets)
+        assert found == sorted([tuple(np.round(t).astype(int))
+                                for t in (t1, t2)])
+        union = np.zeros(len(cand_a), bool)
+        for _, mask in sets:
+            union |= mask
+        assert union.sum() > 100  # both clusters covered
+        # masks are disjoint (inliers removed between rounds)
+        assert (sets[0][1] & sets[1][1]).sum() == 0
+
+    def test_match_pair_union(self):
+        from bigstitcher_spark_tpu.models.matching import (
+            MatchingParams, match_pair,
+        )
+
+        rng = np.random.default_rng(9)
+        # two spatially separated clusters so local descriptors stay clean
+        a = np.concatenate([rng.uniform(0, 200, (40, 3)),
+                            rng.uniform(400, 600, (40, 3))])
+        t1 = np.array([4.0, -3.0, 2.0])
+        t2 = np.array([-15.0, 9.0, -5.0])
+        b = np.concatenate([a[:40] + t1, a[40:] + t2])
+        params = MatchingParams(method="PRECISE_TRANSLATION",
+                                model="TRANSLATION", regularization="NONE",
+                                ransac_min_inliers=10,
+                                ransac_iterations=2000,
+                                ransac_multi_consensus=True)
+        pairs, model, n_cand = match_pair(a, b, params)
+        # both halves matched (single consensus would keep only one half)
+        assert (pairs[:, 0] < 40).sum() > 20
+        assert (pairs[:, 0] >= 40).sum() > 20
