@@ -26,8 +26,20 @@ from .common import (
               help="DoG response threshold")
 @click.option("-dsxy", "--downsampleXY", "downsample_xy", default=2, type=int)
 @click.option("-dsz", "--downsampleZ", "downsample_z", default=1, type=int)
-@click.option("--minIntensity", "min_intensity", default=None, type=float)
-@click.option("--maxIntensity", "max_intensity", default=None, type=float)
+@click.option("-i0", "--minIntensity", "min_intensity", default=None,
+              type=float)
+@click.option("-i1", "--maxIntensity", "max_intensity", default=None,
+              type=float)
+@click.option("--localization", default="QUADRATIC",
+              type=click.Choice(["NONE", "QUADRATIC"]),
+              help="subpixel localization method")
+@click.option("--onlyCompareOverlapTiles", "only_tiles", is_flag=True,
+              default=False,
+              help="with --overlappingOnly, test overlap only against views "
+                   "of the same timepoint+channel (i.e. across tiles)")
+@click.option("--prefetch", is_flag=True, default=False,
+              help="accepted for reference compatibility; chunk prefetch is "
+                   "always on (double-buffered host IO)")
 @click.option("--type", "extrema", default="MAX",
               type=click.Choice(["MAX", "MIN", "BOTH"]),
               help="detect maxima, minima or both")
@@ -63,6 +75,8 @@ def detect_interestpoints_cmd(xml, dry_run, **kw):
         find_max=kw["extrema"] in ("MAX", "BOTH"),
         find_min=kw["extrema"] in ("MIN", "BOTH"),
         overlapping_only=kw["overlapping_only"],
+        localization=kw["localization"],
+        only_compare_overlap_tiles=kw["only_tiles"],
         max_spots=kw["max_spots"],
         max_spots_per_overlap=kw["max_spots_per_overlap"],
         store_intensities=kw["store_intensities"],
@@ -86,7 +100,11 @@ def detect_interestpoints_cmd(xml, dry_run, **kw):
 @xml_option
 @view_selection_options
 @infrastructure_options
-@click.option("-l", "--label", default="beads", help="interest point label")
+@click.option("-l", "--label", "labels", multiple=True, default=("beads",),
+              help="interest point label(s); repeat for multiple")
+@click.option("--matchAcrossLabels", "match_across", is_flag=True,
+              default=False,
+              help="with multiple -l labels, also match between label classes")
 @click.option("-m", "--method", default="FAST_ROTATION",
               type=click.Choice(["FAST_ROTATION", "FAST_TRANSLATION",
                                  "PRECISE_TRANSLATION", "ICP"]),
@@ -109,14 +127,26 @@ def detect_interestpoints_cmd(xml, dry_run, **kw):
 @click.option("--numNeighbors", "n_neighbors", default=3, type=int)
 @click.option("--redundancy", "redundancy", default=1, type=int)
 @click.option("--ransacIterations", default=10000, type=int)
-@click.option("--ransacMaxEpsilon", default=5.0, type=float)
+@click.option("-rme", "--ransacMaxError", "--ransacMaxEpsilon",
+              "ransacmaxepsilon", default=5.0, type=float)
 @click.option("--ransacMinInlierRatio", default=0.1, type=float)
 @click.option("--ransacMinNumInliers", default=12, type=int)
 @click.option("-rmc", "--ransacMultiConsensus", "ransac_multi", is_flag=True,
               default=False,
               help="ransac performs multiconsensus matching")
-@click.option("--icpMaxDistance", default=2.5, type=float)
-@click.option("--icpMaxIterations", default=200, type=int)
+@click.option("-ime", "--icpMaxError", "--icpMaxDistance", "icpmaxdistance",
+              default=2.5, type=float)
+@click.option("-iit", "--icpIterations", "--icpMaxIterations",
+              "icpmaxiterations", default=200, type=int)
+@click.option("--icpUseRANSAC", "icp_use_ransac", is_flag=True, default=False,
+              help="ICP filters correspondences with RANSAC every iteration")
+@click.option("-sr", "--searchRadius", "search_radius", type=float,
+              default=None,
+              help="only for PRECISE_TRANSLATION: limit corresponding points "
+                   "to this distance in global coordinates")
+@click.option("-vr", "--viewReg", "view_reg", default="OVERLAPPING_ONLY",
+              type=click.Choice(["OVERLAPPING_ONLY", "ALL_AGAINST_ALL"]),
+              help="which view pairs to match")
 @click.option("--interestPointsForOverlapOnly", "overlap_only_points",
               is_flag=True, help="match only points inside the pair overlap")
 @click.option("--clearCorrespondences", "clear_corrs", is_flag=True,
@@ -143,8 +173,11 @@ def match_interestpoints_cmd(xml, dry_run, **kw):
 
     sd = load_project(xml)
     views = select_views_from_kwargs(sd, kw)
+    labels = list(kw["labels"]) or ["beads"]
     params = MatchingParams(
-        label=kw["label"], method=kw["method"], model=kw["model"],
+        label=labels[0], labels=tuple(labels[1:]),
+        match_across_labels=kw["match_across"],
+        method=kw["method"], model=kw["model"],
         regularization=kw["reg"], lam=kw["lam"],
         n_neighbors=kw["n_neighbors"], redundancy=kw["redundancy"],
         ratio_of_distance=kw["ratio_of_distance"],
@@ -153,8 +186,11 @@ def match_interestpoints_cmd(xml, dry_run, **kw):
         ransac_min_inlier_ratio=kw["ransacmininlierratio"],
         ransac_min_inliers=kw["ransacminnuminliers"],
         ransac_multi_consensus=kw["ransac_multi"],
+        search_radius=kw["search_radius"],
         icp_max_distance=kw["icpmaxdistance"],
         icp_max_iterations=kw["icpmaxiterations"],
+        icp_use_ransac=kw["icp_use_ransac"],
+        overlap_filter=kw["view_reg"] == "OVERLAPPING_ONLY",
         registration_tp=kw["registration_tp"],
         reference_tp=kw["reference_tp"], range_tp=kw["range_tp"],
         interest_points_for_overlap_only=kw["overlap_only_points"],

@@ -59,6 +59,8 @@ class DetectionParams:
     store_intensities: bool = False
     median_radius: int = 0          # 0 = off (LazyBackgroundSubtract role)
     median_exact: bool = False      # exact per-slice radius-r median
+    localization: str = "QUADRATIC"  # NONE | QUADRATIC subpixel
+    only_compare_overlap_tiles: bool = False  # --onlyCompareOverlapTiles
     block_size: tuple[int, int, int] = (512, 512, 128)
     batch_size: int = 8
     # device-side compaction budget: K strongest candidates per block leave
@@ -196,17 +198,24 @@ def _median_background_divide(block: np.ndarray, radius: int,
 
 def _overlap_boxes_det(
     sd: SpimData, view: ViewId, others: list[ViewId],
-    det_dims, ds, expand_px: int = 2,
+    det_dims, ds, expand_px: int = 2, only_tiles: bool = False,
 ) -> list[Interval]:
     """Overlap regions of ``view`` with each other view, in detection-res
     view-local px (the --overlappingOnly pre-pass,
-    SparkInterestPointDetection.java:291-367)."""
+    SparkInterestPointDetection.java:291-367). ``only_tiles``: compare only
+    same-timepoint same-channel views, i.e. overlap across TILES only
+    (--onlyCompareOverlapTiles, :263-270)."""
     model = sd.model(view)
     inv = invert_affine(model)
     my_box = transformed_interval(model, Interval.from_shape(sd.view_size(view)))
+    my_channel = sd.setups[view.setup].attributes.get("channel", 0)
     out = []
     for o in others:
         if o == view:
+            continue
+        if only_tiles and (
+                o.timepoint != view.timepoint
+                or sd.setups[o.setup].attributes.get("channel", 0) != my_channel):
             continue
         ob = transformed_interval(
             sd.model(o), Interval.from_shape(sd.view_size(o)))
@@ -294,7 +303,9 @@ def detect_interest_points(
         region = Interval.from_shape(plan.det_dims)
         boxes = None
         if params.overlapping_only:
-            boxes = _overlap_boxes_det(sd, v, view_list, plan.det_dims, ds)
+            boxes = _overlap_boxes_det(
+                sd, v, view_list, plan.det_dims, ds,
+                only_tiles=params.only_compare_overlap_tiles)
             overlap_boxes[v] = boxes
             if not boxes:
                 continue
@@ -355,7 +366,9 @@ def detect_interest_points(
         # block-local (with halo) -> view detection-res coords; lexsorted by
         # position so output order is deterministic (top-K rank order would
         # reshuffle under f32 accumulation noise between compilations)
-        pts = (sub[keep].astype(np.float64) - halo
+        src = (sub if params.localization.upper() == "QUADRATIC"
+               else idx)  # --localization NONE keeps integer extrema
+        pts = (src[keep].astype(np.float64) - halo
                + np.array(job.core.min, np.float64))
         vv = vals[keep].astype(np.float64)
         order = np.lexsort(pts.T[::-1])

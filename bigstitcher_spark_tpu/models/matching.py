@@ -43,6 +43,8 @@ class MatchingParams:
     (SparkGeometricDescriptorMatching.java:82,180-189; AbstractRegistration.java:59-108)."""
 
     label: str = "beads"
+    labels: tuple = ()                   # extra labels (-l repeatable)
+    match_across_labels: bool = False    # --matchAcrossLabels
     method: str = D.GEOMETRIC_HASHING   # FAST_ROTATION|FAST_TRANSLATION|PRECISE_TRANSLATION|ICP
     model: str = M.AFFINE
     regularization: str = M.RIGID
@@ -55,8 +57,10 @@ class MatchingParams:
     ransac_min_inlier_ratio: float = 0.1
     ransac_min_inliers: int = 12
     ransac_multi_consensus: bool = False  # --ransacMultiConsensus (-rmc)
+    search_radius: float | None = None   # -sr: world-space candidate limit
     icp_max_distance: float = 2.5
     icp_max_iterations: int = 200
+    icp_use_ransac: bool = False         # --icpUseRANSAC
     registration_tp: str = INDIVIDUAL_TIMEPOINTS
     reference_tp: int = 0
     range_tp: int = 5
@@ -69,6 +73,26 @@ class MatchingParams:
     group_illums: bool = False
     split_timepoints: bool = False
     merge_distance: float = 5.0          # --interestPointMergeDistance
+
+    @property
+    def all_labels(self) -> tuple:
+        out = [self.label]
+        for l in self.labels:
+            if l not in out:
+                out.append(l)
+        return tuple(out)
+
+    def label_pairs(self):
+        """(label_a, label_b) matching tasks: same-label always; unordered
+        cross-label combos with --matchAcrossLabels
+        (MatcherPairwiseTools.getTasksList role)."""
+        ls = self.all_labels
+        out = [(l, l) for l in ls]
+        if self.match_across_labels:
+            for i in range(len(ls)):
+                for j in range(i + 1, len(ls)):
+                    out.append((ls[i], ls[j]))
+        return out
 
     @property
     def grouped(self) -> bool:
@@ -84,6 +108,8 @@ class PairMatchResult:
     ids_b: np.ndarray
     model: np.ndarray | None
     n_candidates: int
+    label_a: str = "beads"
+    label_b: str = "beads"
 
 
 def plan_match_pairs(
@@ -146,6 +172,9 @@ def match_pair(
         res = D.icp(
             wa, wb, params.model, params.regularization, params.lam,
             params.icp_max_distance, params.icp_max_iterations,
+            use_ransac=params.icp_use_ransac,
+            ransac_epsilon=params.ransac_max_epsilon,
+            ransac_iterations=params.ransac_iterations, seed=seed,
         )
         if res is None:
             return np.zeros((0, 2), np.int32), None, 0
@@ -158,6 +187,13 @@ def match_pair(
     )
     if len(cand) == 0:
         return np.zeros((0, 2), np.int32), None, 0
+    if params.search_radius is not None:
+        # -sr limits corresponding points in global coordinate space
+        # (SparkGeometricDescriptorMatching.java:93-94)
+        d = np.linalg.norm(wa[cand[:, 0]] - wb[cand[:, 1]], axis=1)
+        cand = cand[d <= float(params.search_radius)]
+        if len(cand) == 0:
+            return np.zeros((0, 2), np.int32), None, 0
     if params.ransac_multi_consensus:
         sets = D.ransac_multi(
             wa[cand[:, 0]], wb[cand[:, 1]],
@@ -349,7 +385,8 @@ def _match_grouped(
                 continue
             arr = np.array(id_pairs, np.uint64)
             results.append(PairMatchResult(
-                va, vb, arr[:, 0], arr[:, 1], model, n_cand))
+                va, vb, arr[:, 0], arr[:, 1], model, n_cand,
+                label_a=params.label, label_b=params.label))
             if progress:
                 print(f"    {va} <-> {vb}: {len(id_pairs)} correspondences")
     return results
@@ -367,25 +404,33 @@ def match_interest_points(
     params = params or MatchingParams()
     store = store or InterestPointStore.for_project(sd)
     if params.grouped:
+        if len(params.all_labels) > 1 or params.match_across_labels:
+            raise ValueError(
+                "grouped matching (--groupTiles/--groupChannels/"
+                "--groupIllums/--splitTimepoints) supports a single label; "
+                "run ungrouped for multi-label / --matchAcrossLabels")
         return _match_grouped(sd, views, params, store, progress)
     pairs = plan_match_pairs(sd, views, params)
     if progress:
         print(f"matching: {len(pairs)} view pairs, method {params.method}, "
               f"model {params.model} reg {params.regularization} λ={params.lam}")
 
-    cache: dict[ViewId, tuple[np.ndarray, np.ndarray]] = {}
+    cache: dict[tuple, tuple[np.ndarray, np.ndarray]] = {}
 
-    def world(view: ViewId):
-        if view not in cache:
-            ids, locs = store.load_points(view, params.label)
+    def world(view: ViewId, label: str):
+        key = (view, label)
+        if key not in cache:
+            ids, locs = store.load_points(view, label)
             w = apply_affine(sd.model(view), locs) if len(locs) else locs
-            cache[view] = (ids, w)
-        return cache[view]
+            cache[key] = (ids, w)
+        return cache[key]
 
+    label_tasks = params.label_pairs()
     results = []
     for k, (va, vb) in enumerate(pairs):
-        ids_a, wa = world(va)
-        ids_b, wb = world(vb)
+      for la, lb in label_tasks:
+        ids_a, wa = world(va, la)
+        ids_b, wb = world(vb, lb)
         if params.interest_points_for_overlap_only:
             ids_a, wa = _filter_to_overlap(sd, ids_a, wa, va, vb)
             ids_b, wb = _filter_to_overlap(sd, ids_b, wb, vb, va)
@@ -395,7 +440,7 @@ def match_interest_points(
             va, vb,
             ids_a[inl[:, 0]] if len(inl) else np.zeros(0, np.uint64),
             ids_b[inl[:, 1]] if len(inl) else np.zeros(0, np.uint64),
-            model, n_cand,
+            model, n_cand, label_a=la, label_b=lb,
         )
         results.append(res)
         if progress:
@@ -414,15 +459,15 @@ def save_matches(
     (MatcherPairwiseTools.addCorrespondences + save,
     SparkGeometricDescriptorMatching.java:509-545). Existing correspondences
     of re-matched views are kept and merged unless clear_correspondences."""
-    label = params.label
-    new: dict[ViewId, list[CorrespondingPoint]] = {v: [] for v in views}
+    new: dict[tuple, list[CorrespondingPoint]] = {
+        (v, l): [] for v in views for l in params.all_labels}
     for r in results:
         for ia, ib in zip(r.ids_a.astype(int), r.ids_b.astype(int)):
-            new.setdefault(r.view_a, []).append(
-                CorrespondingPoint(ia, r.view_b, label, ib))
-            new.setdefault(r.view_b, []).append(
-                CorrespondingPoint(ib, r.view_a, label, ia))
-    for v, corrs in new.items():
+            new.setdefault((r.view_a, r.label_a), []).append(
+                CorrespondingPoint(ia, r.view_b, r.label_b, ib))
+            new.setdefault((r.view_b, r.label_b), []).append(
+                CorrespondingPoint(ib, r.view_a, r.label_a, ia))
+    for (v, label), corrs in new.items():
         if not params.clear_correspondences:
             existing = store.load_correspondences(v, label)
             seen = {(c.id, c.other_view, c.other_label, c.other_id)

@@ -443,13 +443,19 @@ def icp(
     max_distance: float = 2.5,
     max_iterations: int = 200,
     min_converged: float = 1e-4,
+    use_ransac: bool = False,
+    ransac_epsilon: float = 5.0,
+    ransac_iterations: int = 200,
+    seed: int = 17,
 ) -> tuple[np.ndarray, np.ndarray] | None:
     """Iterative closest point: A is progressively transformed onto B.
 
     Returns (model 3x4 mapping a->b, correspondences (K,2) [ia, ib]) or None.
     Defaults follow the reference (200 iterations, 2.5 px max distance).
     The NN assignment each round is one device distance matrix; the model
-    refit reuses the batched fits.
+    refit reuses the batched fits. ``use_ransac`` filters each round's NN
+    correspondences through a RANSAC consensus before the refit
+    (--icpUseRANSAC, SparkGeometricDescriptorMatching.java:155-156).
     """
     a = np.asarray(points_a, np.float64)
     b = np.asarray(points_b, np.float64)
@@ -458,7 +464,7 @@ def icp(
     model = np.hstack([np.eye(3), np.zeros((3, 1))])
     prev_err = np.inf
     pairs = None
-    for _ in range(max_iterations):
+    for it in range(max_iterations):
         moved = a @ model[:, :3].T + model[:, 3]
         d2 = np.asarray(_pairwise_sqdist(jnp.asarray(moved, jnp.float32),
                                          jnp.asarray(b, jnp.float32)))
@@ -468,6 +474,14 @@ def icp(
         if keep.sum() < max(MIN_POINTS[model_kind], 3):
             return None
         pairs = np.stack([np.where(keep)[0], nn[keep]], 1)
+        if use_ransac:
+            res = ransac(a[pairs[:, 0]], b[pairs[:, 1]], model_kind,
+                         reg_kind, lam, epsilon=ransac_epsilon,
+                         min_inlier_ratio=0.0,
+                         min_inliers=max(MIN_POINTS[model_kind], 3),
+                         iterations=ransac_iterations, seed=seed + it)
+            if res is not None:
+                pairs = pairs[res[1]]
         model = fit_interpolated(model_kind, reg_kind, lam,
                                  a[pairs[:, 0]], b[pairs[:, 1]])
         err = float(nd[keep].mean())

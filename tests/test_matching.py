@@ -521,3 +521,69 @@ class TestMultiConsensusRansac:
         # both halves matched (single consensus would keep only one half)
         assert (pairs[:, 0] < 40).sum() > 20
         assert (pairs[:, 0] >= 40).sum() > 20
+
+
+class TestReferenceOptionParity:
+    """Residual CLI-surface options closed in round 4: searchRadius,
+    matchAcrossLabels label tasks, icpUseRANSAC, viewReg."""
+
+    def test_search_radius_limits_world_distance(self):
+        from bigstitcher_spark_tpu.models.matching import (
+            MatchingParams, match_pair,
+        )
+
+        rng = np.random.default_rng(12)
+        a = rng.uniform(0, 200, (50, 3))
+        b = a + np.array([40.0, 0.0, 0.0]) + rng.normal(0, 0.1, a.shape)
+        base = MatchingParams(method="PRECISE_TRANSLATION",
+                              model="TRANSLATION", regularization="NONE",
+                              ransac_min_inliers=5, ransac_iterations=1000)
+        pairs, _, _ = match_pair(a, b, base)
+        assert len(pairs) > 20  # matches exist at distance ~40
+        import dataclasses
+
+        tight = dataclasses.replace(base, search_radius=10.0)
+        pairs2, _, _ = match_pair(a, b, tight)
+        assert len(pairs2) == 0  # all correspondences are ~40 px apart
+
+    def test_label_pairs_tasks(self):
+        from bigstitcher_spark_tpu.models.matching import MatchingParams
+
+        p = MatchingParams(label="beads", labels=("nuclei",))
+        assert p.label_pairs() == [("beads", "beads"), ("nuclei", "nuclei")]
+        p2 = MatchingParams(label="beads", labels=("nuclei",),
+                            match_across_labels=True)
+        assert ("beads", "nuclei") in p2.label_pairs()
+        assert len(p2.label_pairs()) == 3
+
+    def test_icp_use_ransac_drops_outliers(self):
+        from bigstitcher_spark_tpu.ops.descriptors import icp
+
+        rng = np.random.default_rng(13)
+        a = rng.uniform(0, 200, (60, 3))
+        t = np.array([1.0, -0.5, 0.5])
+        b = a + t
+        # contaminate: 10 points of A get a DIFFERENT consistent shift that
+        # lands within max_distance, dragging the plain-ICP fit off
+        b[:10] = a[:10] + np.array([-2.5, 2.5, 0.0])
+        plain = icp(a, b, "TRANSLATION", "NONE", 0.0, max_distance=4.0)
+        assert plain is not None
+        err_plain = np.abs(plain[0][:, 3] - t).max()
+        res = icp(a, b, "TRANSLATION", "NONE", 0.0, max_distance=4.0,
+                  use_ransac=True, ransac_epsilon=1.0, seed=3)
+        assert res is not None
+        model, pairs = res
+        np.testing.assert_allclose(model[:, 3], t, atol=0.05)
+        # RANSAC filtering excluded the contaminated block from the fit
+        assert np.abs(model[:, 3] - t).max() < err_plain
+        assert (pairs[:, 0] >= 10).all()
+
+    def test_grouped_rejects_multi_label(self):
+        from bigstitcher_spark_tpu.models.matching import (
+            MatchingParams, match_interest_points,
+        )
+
+        with pytest.raises(ValueError, match="single label"):
+            match_interest_points(
+                None, [], MatchingParams(group_tiles=True,
+                                         labels=("nuclei",)), store=object())
