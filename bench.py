@@ -522,6 +522,19 @@ def _log(msg):
           flush=True)
 
 
+def _checkpoint(result):
+    """Write the current (possibly partial) result JSON atomically so the
+    parent can salvage the primary metric if this child is killed by the
+    timeout (tunnel-weather resilience)."""
+    path = os.environ.get("BST_BENCH_PARTIAL")
+    if not path:
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f)
+    os.replace(tmp, path)
+
+
 def child_main():
     import numpy as np
 
@@ -570,16 +583,9 @@ def child_main():
     assert float(diff.mean()) < 1.0 and float(got_blk.std()) > 0.0, (
         f"XLA fusion disagrees with baseline: mean|diff|={diff.mean():.3f}")
     _log("validation ok")
-    kernel = measure_kernel_only(xml)
-    _log(f"kernel-only {kernel['value']:,.0f} vox/s, "
-         f"wire {kernel['wire_d2h_mb_per_sec']} MB/s")
-    pc = measure_phasecorr(xml)
-    _log(f"phasecorr {pc['value']} pairs/s (vs {pc['baseline_pairs_per_sec']})")
-    dog = measure_dog(xml)
-    _log(f"dog {dog['value']:,.0f} vox/s (vs {dog['baseline_vox_per_sec']:,.0f})")
     import jax
 
-    print(json.dumps({
+    result = {
         "metric": "affine_fusion_voxels_per_sec",
         "value": round(vox_per_sec, 1),
         "unit": "voxel/s",
@@ -589,25 +595,67 @@ def child_main():
         "baseline_provenance": "BASELINE_MEASURED.json (measured, this host)",
         "best_of_runs": FUSION_RUNS,
         "spans": best_spans,
-        "extra_metrics": [kernel, pc, dog],
-    }))
+        "extra_metrics": [],
+    }
+    _checkpoint(result)
+    for name, fn in (("kernel", measure_kernel_only),
+                     ("phasecorr", measure_phasecorr),
+                     ("dog", measure_dog)):
+        try:
+            m = fn(xml)
+        except Exception as e:  # a failed extra must not void the primary
+            _log(f"{name} failed: {e!r}")
+            m = {"metric": name, "error": repr(e)[:200]}
+        result["extra_metrics"].append(m)
+        _log(f"{name}: {json.dumps(m)[:160]}")
+        _checkpoint(result)
+    print(json.dumps(result))
+
+
+def _salvage_partial(partial_path, label):
+    """A timed-out child may still have checkpointed the primary metric."""
+    try:
+        with open(partial_path) as f:
+            res = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if res.get("metric") and res.get("value"):
+        res["partial"] = True
+        print(f"[bench] {label}: salvaged partial result "
+              f"(extras done: {len(res.get('extra_metrics', []))}/3)",
+              file=sys.stderr)
+        return json.dumps(res)
+    return None
 
 
 def _spawn_child(env_extra, label):
     env = dict(os.environ)
     env.update(env_extra)
     env["BST_BENCH_CHILD"] = "1"
+    tag = label.replace(" ", "_").replace("/", "-")
+    partial_path = os.path.join(FIXTURE, f"partial_{tag}.json")
+    log_path = os.path.join(FIXTURE, f"child_{tag}.log")
+    env["BST_BENCH_PARTIAL"] = partial_path
+    for p in (partial_path, log_path):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    os.makedirs(FIXTURE, exist_ok=True)
     t0 = time.time()
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, cwd=REPO, timeout=CHILD_TIMEOUT_S,
-            capture_output=True, text=True,
-        )
-    except subprocess.TimeoutExpired:
-        print(f"[bench] {label}: timed out after {CHILD_TIMEOUT_S}s",
-              file=sys.stderr)
-        return None
+    # child stderr streams to a file so progress is observable mid-run
+    # (tail -f <log_path>) and survives a timeout kill
+    with open(log_path, "w") as logf:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, cwd=REPO, timeout=CHILD_TIMEOUT_S,
+                stdout=subprocess.PIPE, stderr=logf, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"[bench] {label}: timed out after {CHILD_TIMEOUT_S}s "
+                  f"(log: {log_path})", file=sys.stderr)
+            return None, _salvage_partial(partial_path, label)
     dt = time.time() - t0
     line = None
     for ln in (proc.stdout or "").splitlines():
@@ -615,11 +663,15 @@ def _spawn_child(env_extra, label):
             line = ln
     if proc.returncode == 0 and line:
         print(f"[bench] {label}: ok in {dt:.0f}s", file=sys.stderr)
-        return line
-    tail = "\n".join(((proc.stderr or "") + (proc.stdout or "")).splitlines()[-15:])
+        return line, None
+    try:
+        with open(log_path) as f:
+            tail = "\n".join((f.read() + (proc.stdout or "")).splitlines()[-15:])
+    except OSError:
+        tail = proc.stdout or ""
     print(f"[bench] {label}: rc={proc.returncode} in {dt:.0f}s\n{tail}",
           file=sys.stderr)
-    return None
+    return None, _salvage_partial(partial_path, label)
 
 
 def _probe_tpu(timeout_s=300):
@@ -649,23 +701,39 @@ def main():
         child_main()
         return 0
     attempts = []
+    tpu_only = bool(os.environ.get("BST_BENCH_TPU_ONLY"))
     if _probe_tpu():
         for i in range(TPU_ATTEMPTS):
             attempts.append(({}, f"tpu attempt {i + 1}/{TPU_ATTEMPTS}"))
+    elif tpu_only:
+        print("[bench] accelerator unreachable (BST_BENCH_TPU_ONLY set)",
+              file=sys.stderr)
+        return 1
     else:
         print("[bench] accelerator unreachable, going straight to cpu",
               file=sys.stderr)
-    attempts.append((
-        {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
-        "cpu fallback",
-    ))
+    if not tpu_only:
+        attempts.append((
+            {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+            "cpu fallback",
+        ))
+    partials = []
     for i, (env_extra, label) in enumerate(attempts):
-        line = _spawn_child(env_extra, label)
-        if line:
+        line, partial = _spawn_child(env_extra, label)
+        if line:  # complete result — done
             print(line)
             return 0
+        if partial:  # keep as fallback, but let later attempts try for a
+            partials.append(partial)  # complete artifact first
         if i + 1 < len(attempts):
             time.sleep(10)
+    if partials:
+        best = max(partials,
+                   key=lambda p: len(json.loads(p).get("extra_metrics", [])))
+        print("[bench] no complete run; reporting best partial",
+              file=sys.stderr)
+        print(best)
+        return 0
     print("[bench] all attempts failed", file=sys.stderr)
     return 1
 

@@ -1,0 +1,338 @@
+"""Minimal Zeiss CZI (ZISRAW) reader: enough to ingest tiled/multi-view
+light-sheet acquisitions as a resave input.
+
+The reference resaves CZI-backed BigStitcher projects through bioformats
+imgloaders (``spimreconstruction.filemap2`` XML: per-view (file, series,
+channel) mappings consumed by FileMapImgLoaderLOCI; resave entry
+SparkResaveN5.java:107-457). This is a from-scratch parser of the public
+ZISRAW container layout — segment stream + subblock directory — supporting
+uncompressed subblocks (compression 0), the common case for raw microscope
+output. Pyramid subblocks (PyramidType != 0) are ignored; series maps to the
+CZI scene (S) dimension the way bioformats enumerates scenes.
+
+No code or structure is taken from any Zeiss SDK; the layout constants follow
+the openly documented file format.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+SEGMENT_HEADER = struct.Struct("<16sqq")  # id, allocated, used
+FILE_HEADER = struct.Struct("<ii8x16s16siqqiq")
+# major, minor, (reserved), primary guid, file guid, part,
+# directory_pos, metadata_pos, update_pending, attachment_dir_pos
+DIR_ENTRY_FIXED = struct.Struct("<2siqiiB5xi")
+# schema "DV", pixel_type, file_position, file_part, compression,
+# pyramid_type, (reserved), dimension_count
+DIM_ENTRY = struct.Struct("<4siifi")
+# dimension, start, size, start_coordinate, stored_size
+SUBBLOCK_FIXED = struct.Struct("<iiq")  # metadata_size, attachment_size, data_size
+
+PIXEL_DTYPES = {
+    0: np.dtype("uint8"),     # Gray8
+    1: np.dtype("uint16"),    # Gray16
+    12: np.dtype("float32"),  # Gray32Float
+}
+
+
+@dataclass
+class SubBlockEntry:
+    file_position: int
+    pixel_type: int
+    compression: int
+    pyramid_type: int
+    dims: dict[str, tuple[int, int]] = field(default_factory=dict)
+    # dimension -> (start, size); stored_size tracked for X/Y
+    stored: dict[str, int] = field(default_factory=dict)
+
+    def start(self, d: str, default: int = 0) -> int:
+        return self.dims.get(d, (default, 1))[0]
+
+    def size(self, d: str, default: int = 1) -> int:
+        return self.dims.get(d, (0, default))[1]
+
+
+class CziFile:
+    """Random-access reader over one .czi file (thread-safe reads)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "rb")
+        sid, _alloc, _used, data_off = self._read_segment_header(0)
+        if sid != b"ZISRAWFILE":
+            raise ValueError(f"{path}: not a CZI file (got {sid!r})")
+        raw = self._pread(data_off, FILE_HEADER.size)
+        (_major, _minor, _pguid, _fguid, _part, dir_pos, meta_pos,
+         _pending, _attach) = FILE_HEADER.unpack(raw)
+        self.metadata_position = meta_pos
+        self.entries = self._read_directory(dir_pos) if dir_pos > 0 else []
+
+    def close(self):
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- low-level ---------------------------------------------------------
+
+    def _pread(self, offset: int, size: int) -> bytes:
+        with self._lock:
+            self._fh.seek(offset)
+            return self._fh.read(size)
+
+    def _read_segment_header(self, offset: int):
+        raw = self._pread(offset, SEGMENT_HEADER.size)
+        if len(raw) < SEGMENT_HEADER.size:
+            raise EOFError(f"{self.path}: truncated segment at {offset}")
+        sid, alloc, used = SEGMENT_HEADER.unpack(raw)
+        return sid.rstrip(b"\x00 "), alloc, used, offset + SEGMENT_HEADER.size
+
+    def _read_directory(self, dir_pos: int) -> list[SubBlockEntry]:
+        sid, _alloc, used, data_off = self._read_segment_header(dir_pos)
+        if sid != b"ZISRAWDIRECTORY":
+            raise ValueError(f"{self.path}: bad directory segment {sid!r}")
+        raw = self._pread(data_off, used)
+        (count,) = struct.unpack_from("<i", raw, 0)
+        pos = 128  # 4-byte count + 124 reserved
+        entries = []
+        for _ in range(count):
+            e, pos = self._parse_dir_entry(raw, pos)
+            entries.append(e)
+        return entries
+
+    @staticmethod
+    def _parse_dir_entry(raw: bytes, pos: int) -> tuple[SubBlockEntry, int]:
+        (schema, pixel_type, file_position, _file_part, compression,
+         pyramid_type, dim_count) = DIR_ENTRY_FIXED.unpack_from(raw, pos)
+        if schema != b"DV":
+            raise ValueError(f"unsupported directory entry schema {schema!r}")
+        pos += DIR_ENTRY_FIXED.size
+        e = SubBlockEntry(file_position, pixel_type, compression, pyramid_type)
+        for _ in range(dim_count):
+            dim, start, size, _startc, stored = DIM_ENTRY.unpack_from(raw, pos)
+            pos += DIM_ENTRY.size
+            name = dim.rstrip(b"\x00 ").decode("ascii")
+            e.dims[name] = (start, size)
+            e.stored[name] = stored
+        return e, pos
+
+    @staticmethod
+    def _entry_size(e: SubBlockEntry) -> int:
+        return DIR_ENTRY_FIXED.size + DIM_ENTRY.size * len(e.dims)
+
+    def read_subblock(self, e: SubBlockEntry) -> np.ndarray:
+        """Decode one subblock as a (Y, X) or (Z, Y, X) array."""
+        sid, _alloc, used, data_off = self._read_segment_header(e.file_position)
+        if sid != b"ZISRAWSUBBLOCK":
+            raise ValueError(f"{self.path}: bad subblock segment {sid!r}")
+        raw = self._pread(data_off, SUBBLOCK_FIXED.size)
+        metadata_size, _attach_size, data_size = SUBBLOCK_FIXED.unpack(raw)
+        header_size = max(256, SUBBLOCK_FIXED.size + self._entry_size(e))
+        payload_off = data_off + header_size + metadata_size
+        dtype = PIXEL_DTYPES.get(e.pixel_type)
+        if dtype is None:
+            raise NotImplementedError(
+                f"{self.path}: CZI pixel type {e.pixel_type} not supported "
+                f"(supported: Gray8/Gray16/Gray32Float)")
+        if e.compression != 0:
+            raise NotImplementedError(
+                f"{self.path}: compressed CZI subblocks (compression="
+                f"{e.compression}) not supported; resave from uncompressed "
+                "CZI or convert with Zeiss tools first")
+        sx = e.stored.get("X", e.size("X"))
+        sy = e.stored.get("Y", e.size("Y"))
+        sz = e.size("Z", 1) if "Z" in e.dims else 1
+        count = sx * sy * sz
+        buf = self._pread(payload_off, count * dtype.itemsize)
+        if len(buf) < count * dtype.itemsize or data_size < count * dtype.itemsize:
+            raise EOFError(f"{self.path}: truncated subblock payload")
+        arr = np.frombuffer(buf, dtype=dtype, count=count)
+        return arr.reshape((sz, sy, sx)) if sz > 1 else arr.reshape((sy, sx))
+
+    # -- volume assembly ---------------------------------------------------
+
+    def scenes(self) -> list[int]:
+        ids = {e.start("S", 0) for e in self.entries if e.pyramid_type == 0}
+        return sorted(ids)
+
+    def read_volume(self, scene: int = 0, channel: int = 0,
+                    timepoint: int = 0, illumination: int | None = None
+                    ) -> np.ndarray:
+        """Assemble the (X, Y, Z) volume of one view.
+
+        Subblocks are placed by their Z start; X/Y starts are normalized to
+        the scene's minimum (mosaic-free single-tile scenes — the BigStitcher
+        Z.1/tiled-acquisition case where each scene is one stack)."""
+        sel = [
+            e for e in self.entries
+            if e.pyramid_type == 0
+            and e.start("S", 0) == scene
+            and e.start("C", 0) == channel
+            and e.start("T", 0) == timepoint
+            and (illumination is None or e.start("I", 0) == illumination)
+        ]
+        if not sel:
+            raise ValueError(
+                f"{self.path}: no subblocks for scene={scene} "
+                f"channel={channel} timepoint={timepoint}")
+        # refuse silent overlay: any dimension beyond the filtered/spatial
+        # ones that still varies (e.g. I illumination, R rotation) would make
+        # subblocks overwrite each other last-write-wins
+        filtered = {"X", "Y", "Z", "S", "C", "T"}
+        if illumination is not None:
+            filtered.add("I")
+        varying = {
+            d for e in sel for d in e.dims
+            if d not in filtered
+            and len({x.start(d, 0) for x in sel}) > 1
+        }
+        if varying:
+            raise NotImplementedError(
+                f"{self.path}: subblocks vary in unhandled CZI dimension(s) "
+                f"{sorted(varying)} for scene={scene} channel={channel}; "
+                "pass illumination= for I, other dimensions are not "
+                "supported by the filemap loader")
+        x0 = min(e.start("X", 0) for e in sel)
+        y0 = min(e.start("Y", 0) for e in sel)
+        z0 = min(e.start("Z", 0) for e in sel)
+        nx = max(e.start("X", 0) - x0 + e.size("X") for e in sel)
+        ny = max(e.start("Y", 0) - y0 + e.size("Y") for e in sel)
+        nz = max(e.start("Z", 0) - z0 + e.size("Z", 1) for e in sel)
+        dtype = PIXEL_DTYPES.get(sel[0].pixel_type)
+        if dtype is None:
+            raise NotImplementedError(
+                f"{self.path}: CZI pixel type {sel[0].pixel_type} not supported")
+        vol = np.zeros((nz, ny, nx), dtype=dtype)
+        for e in sel:
+            plane = self.read_subblock(e)
+            zs = e.start("Z", 0) - z0
+            ys = e.start("Y", 0) - y0
+            xs = e.start("X", 0) - x0
+            if plane.ndim == 2:
+                vol[zs, ys:ys + plane.shape[0], xs:xs + plane.shape[1]] = plane
+            else:
+                vol[zs:zs + plane.shape[0], ys:ys + plane.shape[1],
+                    xs:xs + plane.shape[2]] = plane
+        return vol.transpose(2, 1, 0)  # (X, Y, Z)
+
+    def metadata_xml(self) -> str:
+        if self.metadata_position <= 0:
+            return ""
+        sid, _alloc, used, data_off = self._read_segment_header(
+            self.metadata_position)
+        if sid != b"ZISRAWMETADATA":
+            return ""
+        raw = self._pread(data_off, 16)
+        (xml_size,) = struct.unpack_from("<i", raw, 0)
+        return self._pread(data_off + 256, xml_size).decode(
+            "utf-8", errors="replace")
+
+
+# ---------------------------------------------------------------------------
+# Writer — test/fixture support (also makes this module self-verifying: the
+# reader is exercised against files produced to the same public layout).
+# ---------------------------------------------------------------------------
+
+
+def _pixel_type_of(dtype) -> int:
+    for pt, dt in PIXEL_DTYPES.items():
+        if dt == np.dtype(dtype):
+            return pt
+    raise ValueError(f"unsupported dtype for CZI: {dtype}")
+
+
+def write_czi(path: str, views: list[dict]) -> None:
+    """Write a minimal CZI: one uncompressed Z-plane subblock per slice.
+
+    ``views``: dicts with keys ``data`` ((X,Y,Z) array) and optional
+    ``scene``/``channel``/``timepoint``/``illumination`` ints."""
+    segments = []  # (id, payload bytes) — positions patched at the end
+
+    def seg(sid: bytes, payload: bytes) -> int:
+        segments.append([sid, payload])
+        return len(segments) - 1
+
+    entries = []  # (entry_bytes_fn, segment_index)
+    dir_entries_raw = []
+
+    for v in views:
+        data = np.asarray(v["data"])
+        if data.ndim != 3:
+            raise ValueError("view data must be (X, Y, Z)")
+        pt = _pixel_type_of(data.dtype)
+        zyx = data.transpose(2, 1, 0)  # (Z, Y, X) planes
+        for z in range(zyx.shape[0]):
+            plane = np.ascontiguousarray(zyx[z])
+            dims = [
+                (b"X", 0, plane.shape[1], plane.shape[1]),
+                (b"Y", 0, plane.shape[0], plane.shape[0]),
+                (b"Z", z, 1, 1),
+                (b"C", int(v.get("channel", 0)), 1, 1),
+                (b"T", int(v.get("timepoint", 0)), 1, 1),
+                (b"S", int(v.get("scene", 0)), 1, 1),
+            ]
+            if "illumination" in v:
+                dims.append((b"I", int(v["illumination"]), 1, 1))
+            entry_fixed_args = (b"DV", pt, 0, 0, 0, 0, len(dims))
+            dim_bytes = b"".join(
+                DIM_ENTRY.pack(d, start, size, float(start), stored)
+                for d, start, size, stored in dims)
+            entry_size = DIR_ENTRY_FIXED.size + len(dim_bytes)
+            header_size = max(256, SUBBLOCK_FIXED.size + entry_size)
+            payload = plane.tobytes()
+            sub = bytearray()
+            sub += SUBBLOCK_FIXED.pack(0, 0, len(payload))
+            sub += DIR_ENTRY_FIXED.pack(*entry_fixed_args)
+            sub += dim_bytes
+            sub += b"\x00" * (header_size - len(sub))
+            sub += payload
+            idx = seg(b"ZISRAWSUBBLOCK", bytes(sub))
+            dir_entries_raw.append((entry_fixed_args, dim_bytes, idx))
+
+    # layout: file header first, then subblocks, then directory; the header
+    # payload is packed once positions are known (placeholder sizes match:
+    # FILE_HEADER is fixed-size)
+    out_positions = {}
+    offset = 0
+    blobs = []
+    all_segments = [[b"ZISRAWFILE", b"\x00" * FILE_HEADER.size]] + segments
+    for i, (sid, payload) in enumerate(all_segments):
+        alloc = ((max(len(payload), 32) + 31) // 32) * 32  # 32-byte alignment
+        out_positions[i] = offset
+        blobs.append((sid, payload, alloc))
+        offset += SEGMENT_HEADER.size + alloc
+    dir_pos = offset
+
+    dir_body = bytearray()
+    dir_body += struct.pack("<i", len(dir_entries_raw))
+    dir_body += b"\x00" * 124
+    for entry_fixed_args, dim_bytes, idx in dir_entries_raw:
+        args = list(entry_fixed_args)
+        args[2] = out_positions[idx + 1]  # +1: file header prepended
+        dir_body += DIR_ENTRY_FIXED.pack(*args)
+        dir_body += dim_bytes
+
+    with open(path, "wb") as f:
+        for i, (sid, payload, alloc) in enumerate(blobs):
+            if sid == b"ZISRAWFILE":
+                payload = FILE_HEADER.pack(1, 0, b"\x00" * 16, b"\x00" * 16,
+                                           0, dir_pos, 0, 0, 0)
+            f.write(SEGMENT_HEADER.pack(sid.ljust(16, b"\x00"), alloc,
+                                        len(payload)))
+            f.write(payload.ljust(alloc, b"\x00"))
+        f.write(SEGMENT_HEADER.pack(b"ZISRAWDIRECTORY".ljust(16, b"\x00"),
+                                    len(dir_body), len(dir_body)))
+        f.write(bytes(dir_body))
+
+
+__all__ = ["CziFile", "write_czi", "PIXEL_DTYPES"]

@@ -210,6 +210,151 @@ class TiffStackLoader:
             return xyz
 
 
+class _LazyCziDataset:
+    """Defers the CZI volume assembly until pixels are read."""
+
+    def __init__(self, loader: "CziFileMapLoader", view, shape):
+        self._loader = loader
+        self._view = view
+        self.shape = tuple(int(v) for v in shape)
+
+    @property
+    def dtype(self):
+        return self._loader.dtype(self._view)
+
+    def read(self, offset, shape):
+        sel = tuple(slice(int(o), int(o) + int(s))
+                    for o, s in zip(offset, shape))
+        return self._loader.load(self._view)[sel]
+
+    def read_full(self):
+        return self._loader.load(self._view)
+
+
+class CziFileMapLoader:
+    """CZI input via per-view file mappings (mvrecon FileMapImgLoaderLOCI2,
+    format ``spimreconstruction.filemap2``): the dataset XML maps each
+    (setup, timepoint) to (file, series, channel); series is the CZI scene.
+    This is the input side the reference's resave ingests through bioformats
+    (SparkResaveN5.java:107-457); the CZI container itself is parsed by the
+    from-scratch reader in ``io.czi``."""
+
+    def __init__(self, sd: SpimData, base_dir: str):
+        raw = sd.image_loader.raw
+        if raw is None:
+            raise ValueError("filemap2 loader needs the raw ImageLoader XML")
+        self.sd = sd
+        self.base_dir = base_dir
+        self.mappings: dict[tuple[int, int], tuple[str, int, int]] = {}
+        for fm in raw.findall(".//FileMapping"):
+            key = (int(fm.get("view_setup")), int(fm.get("timepoint")))
+            path = fm.get("file") or fm.findtext("file") or ""
+            if not os.path.isabs(path):
+                path = os.path.join(base_dir, path)
+            self.mappings[key] = (path, int(fm.get("series", 0)),
+                                  int(fm.get("channel", 0)))
+        if not self.mappings:
+            raise ValueError("filemap2 loader XML has no <FileMapping> entries")
+        self._files: dict[str, object] = {}
+        self._max_open_files = 16  # bound fds on one-CZI-per-timepoint projects
+        self._cache: dict[tuple[int, int], np.ndarray] = {}
+        self._dtype_cache: dict[tuple[str, int, int], np.dtype] = {}
+        self._lock = threading.Lock()
+        self._key_locks: dict[tuple[int, int], threading.Lock] = {}
+
+    def _mapping(self, view: ViewId) -> tuple[str, int, int]:
+        try:
+            return self.mappings[(view.setup, view.timepoint)]
+        except KeyError:
+            raise ValueError(
+                f"no file mapping for setup {view.setup} "
+                f"timepoint {view.timepoint}") from None
+
+    def _czi(self, path: str):
+        from .czi import CziFile
+
+        with self._lock:
+            cz = self._files.get(path)
+            if cz is None:
+                while len(self._files) >= self._max_open_files:
+                    self._files.pop(next(iter(self._files))).close()
+                cz = self._files[path] = CziFile(path)
+            return cz
+
+    def dtype(self, view: ViewId) -> np.dtype:
+        """Cheap probe from the subblock directory (no pixel decode);
+        memoized per (file, scene, channel) — the probe runs on every
+        boxed read, the directory scan must not."""
+        from .czi import PIXEL_DTYPES
+
+        path, scene, channel = self._mapping(view)
+        key = (path, scene, channel)
+        with self._lock:
+            dt = self._dtype_cache.get(key)
+        if dt is not None:
+            return dt
+        cz = self._czi(path)
+        for e in cz.entries:
+            if (e.pyramid_type == 0 and e.start("S", 0) == scene
+                    and e.start("C", 0) == channel):
+                dt = PIXEL_DTYPES.get(e.pixel_type)
+                if dt is not None:
+                    with self._lock:
+                        self._dtype_cache[key] = dt
+                    return dt
+        raise ValueError(f"{path}: no subblocks for scene={scene} "
+                         f"channel={channel}")
+
+    def _file_timepoint(self, cz, scene: int, channel: int,
+                        timepoint: int) -> int:
+        """Map the project timepoint to the in-file CZI T index: use it when
+        the file contains it; otherwise, a file holding a single T (the
+        one-CZI-per-timepoint export — the FileMapping already resolved the
+        timepoint to this file) maps to that T."""
+        ts = {e.start("T", 0) for e in cz.entries
+              if (e.pyramid_type == 0 and e.start("S", 0) == scene
+                  and e.start("C", 0) == channel)}
+        if timepoint in ts:
+            return timepoint
+        if len(ts) == 1:
+            return next(iter(ts))
+        raise ValueError(
+            f"{cz.path}: project timepoint {timepoint} not in file "
+            f"(T indices {sorted(ts)}) and file is multi-timepoint")
+
+    def load(self, view: ViewId) -> np.ndarray:
+        path, scene, channel = self._mapping(view)
+        key = (view.setup, view.timepoint)
+        # per-key lock: one decode per view even under the resave/detection
+        # thread pools (same discipline as TiffStackLoader)
+        with self._lock:
+            if key in self._cache:
+                return self._cache[key]
+            klock = self._key_locks.setdefault(key, threading.Lock())
+        with klock:
+            with self._lock:
+                if key in self._cache:
+                    return self._cache[key]
+            cz = self._czi(path)
+            t = self._file_timepoint(cz, scene, channel, view.timepoint)
+            try:
+                vol = cz.read_volume(scene=scene, channel=channel, timepoint=t)
+            except NotImplementedError as e:
+                if "'I'" not in str(e):
+                    raise
+                # dual-illumination file: the view setup's illumination
+                # attribute selects the in-file I index
+                illum = self.sd.setups[view.setup].attributes.get(
+                    "illumination", 0)
+                vol = cz.read_volume(scene=scene, channel=channel,
+                                     timepoint=t, illumination=illum)
+            with self._lock:
+                if len(self._cache) >= 4:  # bound resident volumes
+                    self._cache.pop(next(iter(self._cache)))
+                self._cache[key] = vol
+            return vol
+
+
 class ViewLoader:
     """Opens view images of a SpimData project (bdv.n5 loader equivalent)."""
 
@@ -217,10 +362,16 @@ class ViewLoader:
         self.sd = spimdata
         fmt = spimdata.image_loader.format
         self.is_hdf5 = fmt == "bdv.hdf5"
-        self.is_tiff = fmt.startswith("spimreconstruction")
-        if fmt not in ("bdv.n5", "bdv.zarr", "bdv.hdf5") and not self.is_tiff:
+        self.is_filemap = fmt == "spimreconstruction.filemap2"
+        self.is_tiff = fmt.startswith("spimreconstruction") and not self.is_filemap
+        if fmt not in ("bdv.n5", "bdv.zarr", "bdv.hdf5") and not self.is_tiff \
+                and not self.is_filemap:
             raise NotImplementedError(f"image loader format {fmt!r} not supported yet")
-        if self.is_tiff:
+        if self.is_filemap:
+            base = os.path.dirname(spimdata.xml_path or ".")
+            self.store = None
+            self._filemap = CziFileMapLoader(spimdata, base)
+        elif self.is_tiff:
             base = os.path.dirname(spimdata.xml_path or ".")
             self.store = None
             self._tiff = TiffStackLoader(spimdata, base)
@@ -243,7 +394,7 @@ class ViewLoader:
         # ids, so resolve against the store directly — no recursion)
         split = self.sd.split_info.get(setup)
         src = split[0] if split is not None else setup
-        if self.is_tiff:
+        if self.is_tiff or self.is_filemap:
             return [[1, 1, 1]]
         if src not in self._factors_cache:
             if self.is_hdf5:
@@ -263,6 +414,12 @@ class ViewLoader:
 
     def _open_raw(self, setup: int, timepoint: int, level: int) -> Dataset:
         key = (setup, timepoint, level)
+        if self.is_filemap:
+            if level != 0:
+                raise ValueError("CZI file maps have no pyramid levels")
+            view = ViewId(timepoint, setup)
+            return _LazyCziDataset(self._filemap, view,
+                                   self.sd.view_size(view))
         if self.is_tiff:
             if level != 0:
                 raise ValueError("TIFF stacks have no pyramid levels")

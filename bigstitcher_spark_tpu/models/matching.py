@@ -83,15 +83,18 @@ class MatchingParams:
         return tuple(out)
 
     def label_pairs(self):
-        """(label_a, label_b) matching tasks: same-label always; unordered
-        cross-label combos with --matchAcrossLabels
+        """(label_a, label_b) matching tasks: same-label always; with
+        --matchAcrossLabels BOTH directions of every cross-label combo,
+        because each view pair is planned once unordered — (beads of A vs
+        nuclei of B) and (nuclei of A vs beads of B) are distinct pairings
         (MatcherPairwiseTools.getTasksList role)."""
         ls = self.all_labels
         out = [(l, l) for l in ls]
         if self.match_across_labels:
             for i in range(len(ls)):
-                for j in range(i + 1, len(ls)):
-                    out.append((ls[i], ls[j]))
+                for j in range(len(ls)):
+                    if i != j:
+                        out.append((ls[i], ls[j]))
         return out
 
     @property
@@ -427,8 +430,8 @@ def match_interest_points(
 
     label_tasks = params.label_pairs()
     results = []
-    for k, (va, vb) in enumerate(pairs):
-      for la, lb in label_tasks:
+    tasks = [(va, vb, la, lb) for va, vb in pairs for la, lb in label_tasks]
+    for k, (va, vb, la, lb) in enumerate(tasks):
         ids_a, wa = world(va, la)
         ids_b, wb = world(vb, lb)
         if params.interest_points_for_overlap_only:

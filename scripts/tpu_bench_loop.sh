@@ -10,7 +10,7 @@ SLEEP=${2:-240}
 for i in $(seq 1 "$ATTEMPTS"); do
   if timeout 150 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d; print('live', d[0].platform)" >/tmp/tpu_probe.log 2>&1; then
     echo "[loop $(date +%T)] tunnel live ($(cat /tmp/tpu_probe.log)), running bench"
-    if timeout 3000 python bench.py >/tmp/bench_tpu_out.json 2>/tmp/bench_tpu_err.log; then
+    if timeout 5500 env BST_BENCH_TPU_ONLY=1 BST_BENCH_CHILD_TIMEOUT=2500 python bench.py >/tmp/bench_tpu_out.json 2>/tmp/bench_tpu_err.log; then
       if grep -q '"platform": "cpu"' /tmp/bench_tpu_out.json; then
         echo "[loop $(date +%T)] bench fell back to cpu; retrying later"
       else

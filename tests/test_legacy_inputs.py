@@ -170,3 +170,194 @@ class TestSetupIdMapper:
             assert sd.setups[new].name == sd0.setups[old].name
             np.testing.assert_array_equal(
                 sd.model(ViewId(0, new)), sd0.model(ViewId(0, old)))
+
+
+@pytest.fixture(scope="module")
+def czi_project(tmp_path_factory):
+    """Two-tile, two-channel project stored as one CZI file (scenes = tiles)
+    + filemap2 ImageLoader XML — the reference's Zeiss-acquisition entry
+    point (FileMapImgLoaderLOCI2 / bioformats)."""
+    from bigstitcher_spark_tpu.io.czi import write_czi
+    from bigstitcher_spark_tpu.io.spimdata import (
+        AttributeEntity, ImageLoader, SpimData as SD, ViewSetup, ViewTransform,
+    )
+    from bigstitcher_spark_tpu.utils.geometry import translation_affine
+
+    root = tmp_path_factory.mktemp("cziproj")
+    size = (36, 28, 6)  # xyz
+    rng = np.random.default_rng(7)
+    vols = {}
+    views = []
+    for tile in (0, 1):
+        for ch in (0, 1):
+            vol = rng.integers(50, 4000, size=size).astype(np.uint16)
+            vols[(tile, ch)] = vol
+            views.append({"data": vol, "scene": tile, "channel": ch})
+    czi_path = str(root / "acq.czi")
+    write_czi(czi_path, views)
+
+    sd = SD()
+    raw = ET.Element("ImageLoader", format="spimreconstruction.filemap2",
+                     version="0.1")
+    files = ET.SubElement(raw, "files")
+    setup = 0
+    setup_of = {}
+    for tile in (0, 1):
+        for ch in (0, 1):
+            ET.SubElement(files, "FileMapping", view_setup=str(setup),
+                          timepoint="0", file="acq.czi", series=str(tile),
+                          channel=str(ch))
+            setup_of[(tile, ch)] = setup
+            setup += 1
+    sd.image_loader = ImageLoader(format="spimreconstruction.filemap2", raw=raw)
+    sd.timepoints = [0]
+    sd.attributes["illumination"][0] = AttributeEntity(0, "0")
+    sd.attributes["angle"][0] = AttributeEntity(0, "0")
+    for ch in (0, 1):
+        sd.attributes["channel"][ch] = AttributeEntity(ch, str(ch))
+    for tile in (0, 1):
+        sd.attributes["tile"][tile] = AttributeEntity(tile, str(tile))
+    for (tile, ch), s in setup_of.items():
+        sd.setups[s] = ViewSetup(
+            id=s, name=f"tile{tile}ch{ch}", size=size,
+            attributes={"illumination": 0, "channel": ch, "tile": tile,
+                        "angle": 0})
+        sd.registrations[ViewId(0, s)] = [
+            ViewTransform("grid", translation_affine((tile * 30.0, 0, 0)))]
+    xml = str(root / "dataset.xml")
+    sd.save(xml)
+    return xml, vols, setup_of
+
+
+class TestCziLoader:
+    def test_czi_round_trip(self, tmp_path):
+        """Reader parity with the writer across dtypes and dimensions."""
+        from bigstitcher_spark_tpu.io.czi import CziFile, write_czi
+
+        rng = np.random.default_rng(1)
+        v8 = rng.integers(0, 255, (20, 16, 4), dtype=np.uint8)
+        vf = rng.random((10, 8, 2)).astype(np.float32)
+        path = str(tmp_path / "t.czi")
+        write_czi(path, [{"data": v8, "scene": 0},
+                         {"data": vf, "scene": 0, "channel": 1}])
+        with CziFile(path) as cz:
+            assert cz.scenes() == [0]
+            np.testing.assert_array_equal(cz.read_volume(0, 0), v8)
+            np.testing.assert_array_equal(cz.read_volume(0, 1), vf)
+
+    def test_reads_views(self, czi_project):
+        xml, vols, setup_of = czi_project
+        sd = SpimData.load(xml)
+        assert sd.image_loader.format == "spimreconstruction.filemap2"
+        loader = ViewLoader(sd)
+        for (tile, ch), s in setup_of.items():
+            ds = loader.open(ViewId(0, s), 0)
+            assert ds.dtype == np.dtype("uint16")
+            assert (ds.read_full() == vols[(tile, ch)]).all()
+        blk = loader.read_block(ViewId(0, 0), 0, (-2, 0, 0), (6, 6, 4))
+        assert (blk[:2] == 0).all() and blk[2:].std() > 0
+
+    def test_resave_from_czi(self, czi_project, tmp_path):
+        """resave ingests the CZI project and rewrites it as bdv.n5."""
+        xml, vols, setup_of = czi_project
+        out_xml = str(tmp_path / "resaved.xml")
+        r = CliRunner().invoke(cli, [
+            "resave", "-x", xml, "-xo", out_xml,
+            "-o", str(tmp_path / "resaved.n5"), "--N5",
+            "-ds", "1,1,1", "--blockSize", "16,16,8",
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        sd = SpimData.load(out_xml)
+        assert sd.image_loader.format == "bdv.n5"
+        loader = ViewLoader(sd)
+        for (tile, ch), s in setup_of.items():
+            got = loader.open(ViewId(0, s), 0).read_full()
+            assert (got == vols[(tile, ch)]).all()
+
+    def test_single_timepoint_file_at_later_timepoint(self, tmp_path):
+        """One CZI per timepoint (in-file T=0): the mapping resolves the
+        project timepoint to the file, the loader maps to the file's only T."""
+        from bigstitcher_spark_tpu.io.czi import write_czi
+        from bigstitcher_spark_tpu.io.spimdata import (
+            AttributeEntity, ImageLoader, SpimData as SD, ViewSetup,
+            ViewTransform,
+        )
+        from bigstitcher_spark_tpu.utils.geometry import identity_affine
+
+        size = (12, 10, 3)
+        rng = np.random.default_rng(3)
+        vols = {t: rng.integers(0, 4000, size, dtype=np.uint16)
+                for t in (0, 5)}
+        for t, vol in vols.items():
+            write_czi(str(tmp_path / f"tp{t}.czi"), [{"data": vol}])
+
+        sd = SD()
+        raw = ET.Element("ImageLoader", format="spimreconstruction.filemap2")
+        files = ET.SubElement(raw, "files")
+        for t in vols:
+            ET.SubElement(files, "FileMapping", view_setup="0",
+                          timepoint=str(t), file=f"tp{t}.czi", series="0",
+                          channel="0")
+        sd.image_loader = ImageLoader(format="spimreconstruction.filemap2",
+                                      raw=raw)
+        sd.timepoints = sorted(vols)
+        for attr in ("illumination", "channel", "tile", "angle"):
+            sd.attributes[attr][0] = AttributeEntity(0, "0")
+        sd.setups[0] = ViewSetup(id=0, name="v0", size=size, attributes={
+            "illumination": 0, "channel": 0, "tile": 0, "angle": 0})
+        for t in vols:
+            sd.registrations[ViewId(t, 0)] = [
+                ViewTransform("id", identity_affine())]
+        xml = str(tmp_path / "dataset.xml")
+        sd.save(xml)
+        loader = ViewLoader(SpimData.load(xml))
+        for t, vol in vols.items():
+            np.testing.assert_array_equal(
+                loader.open(ViewId(t, 0), 0).read_full(), vol)
+
+    def test_dual_illumination(self, tmp_path):
+        """Subblocks varying in I must not silently overlay; the loader
+        selects by the view setup's illumination attribute."""
+        from bigstitcher_spark_tpu.io.czi import CziFile, write_czi
+        from bigstitcher_spark_tpu.io.spimdata import (
+            AttributeEntity, ImageLoader, SpimData as SD, ViewSetup,
+            ViewTransform,
+        )
+        from bigstitcher_spark_tpu.utils.geometry import identity_affine
+
+        size = (10, 8, 2)
+        rng = np.random.default_rng(9)
+        vols = {i: rng.integers(0, 4000, size, dtype=np.uint16) for i in (0, 1)}
+        path = str(tmp_path / "dual.czi")
+        write_czi(path, [{"data": vols[i], "illumination": i} for i in (0, 1)])
+        with CziFile(path) as cz:
+            with pytest.raises(NotImplementedError, match="'I'"):
+                cz.read_volume(0, 0)
+            np.testing.assert_array_equal(
+                cz.read_volume(0, 0, illumination=1), vols[1])
+
+        sd = SD()
+        raw = ET.Element("ImageLoader", format="spimreconstruction.filemap2")
+        files = ET.SubElement(raw, "files")
+        for i in (0, 1):
+            ET.SubElement(files, "FileMapping", view_setup=str(i),
+                          timepoint="0", file="dual.czi", series="0",
+                          channel="0")
+        sd.image_loader = ImageLoader(format="spimreconstruction.filemap2",
+                                      raw=raw)
+        sd.timepoints = [0]
+        for attr in ("channel", "tile", "angle"):
+            sd.attributes[attr][0] = AttributeEntity(0, "0")
+        for i in (0, 1):
+            sd.attributes["illumination"][i] = AttributeEntity(i, str(i))
+            sd.setups[i] = ViewSetup(id=i, name=f"illum{i}", size=size,
+                attributes={"illumination": i, "channel": 0, "tile": 0,
+                            "angle": 0})
+            sd.registrations[ViewId(0, i)] = [
+                ViewTransform("id", identity_affine())]
+        xml = str(tmp_path / "dataset.xml")
+        sd.save(xml)
+        loader = ViewLoader(SpimData.load(xml))
+        for i in (0, 1):
+            np.testing.assert_array_equal(
+                loader.open(ViewId(0, i), 0).read_full(), vols[i])

@@ -553,8 +553,12 @@ class TestReferenceOptionParity:
         assert p.label_pairs() == [("beads", "beads"), ("nuclei", "nuclei")]
         p2 = MatchingParams(label="beads", labels=("nuclei",),
                             match_across_labels=True)
+        # BOTH directions of the cross combo: view pairs are unordered, so
+        # (beads of A vs nuclei of B) and (nuclei of A vs beads of B) are
+        # distinct tasks
         assert ("beads", "nuclei") in p2.label_pairs()
-        assert len(p2.label_pairs()) == 3
+        assert ("nuclei", "beads") in p2.label_pairs()
+        assert len(p2.label_pairs()) == 4
 
     def test_icp_use_ransac_drops_outliers(self):
         from bigstitcher_spark_tpu.ops.descriptors import icp
