@@ -517,6 +517,277 @@ def measure_kernel_only(xml_path):
     }
 
 
+MULTITP_SPEC = {
+    "n_tiles": (2, 2, 1), "tile_size": (128, 128, 64), "overlap": 32,
+    "jitter": 0.0, "seed": 23, "block_size": (64, 64, 32),
+    "n_beads_per_tile": 60, "n_channels": 2, "n_timepoints": 2,
+}
+
+
+def _slot_views(sd, c_idx, t_idx):
+    """Views of the container slot (channel index, timepoint index) —
+    mrInfos[c + t*numChannels] selection (SparkAffineFusion.java:426-441)."""
+    channels = sorted({s.attributes.get("channel", 0)
+                      for s in sd.setups.values()})
+    tps = sorted(sd.timepoints)
+    ch = channels[c_idx]
+    tp = tps[t_idx]
+    return [v for v in sd.view_ids()
+            if v.timepoint == tp
+            and sd.setups[v.setup].attributes.get("channel", 0) == ch]
+
+
+def measure_multitp():
+    """Multi-timepoint multi-channel affine fusion -> 5-D OME-ZARR
+    (BASELINE.md config), all four (c,t) slots, vs the same numpy baseline
+    fusion run per slot."""
+    import numpy as np
+
+    from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+    from bigstitcher_spark_tpu.io.container import create_fusion_container
+    from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+    from bigstitcher_spark_tpu.io.spimdata import SpimData
+    from bigstitcher_spark_tpu.models.affine_fusion import fuse_volume
+    from bigstitcher_spark_tpu.utils.geometry import Interval
+    from bigstitcher_spark_tpu.utils.grid import create_grid
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+    from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+    root = os.path.join(FIXTURE, "multitp")
+    xml = os.path.join(root, "proj", "dataset.xml")
+    if not os.path.exists(xml):
+        make_synthetic_project(os.path.join(root, "proj"), **MULTITP_SPEC)
+    sd = SpimData.load(xml)
+    loader = ViewLoader(sd)
+    bbox = maximal_bounding_box(sd, sd.view_ids())
+    out = os.path.join(root, "fused.ome.zarr")
+    n_ch = MULTITP_SPEC["n_channels"]
+    n_tp = MULTITP_SPEC["n_timepoints"]
+
+    def run():
+        shutil.rmtree(out, ignore_errors=True)
+        create_fusion_container(
+            out, StorageFormat.ZARR, xml, n_tp, n_ch, bbox,
+            data_type="uint16", block_size=(64, 64, 32),
+            min_intensity=0.0, max_intensity=65535.0)
+        ds = ChunkStore.open(out).open_dataset("0")
+        for t in range(n_tp):
+            for c in range(n_ch):
+                fuse_volume(
+                    sd, loader, _slot_views(sd, c, t), ds, bbox,
+                    block_size=(64, 64, 32), block_scale=(2, 2, 1),
+                    fusion_type="AVG_BLEND", out_dtype="uint16",
+                    min_intensity=0.0, max_intensity=65535.0, zarr_ct=(c, t))
+        return ds
+
+    run()  # warm compiles
+    t0 = time.time()
+    ds = run()
+    dt = time.time() - t0
+    vox = int(np.prod(bbox.shape)) * n_ch * n_tp
+
+    # baseline: the same numpy fusion per slot (cached)
+    cache = _baseline_cache_load()
+    key = _fixture_key(f"multitp-{MULTITP_SPEC}")
+    ent = cache.get("multitp")
+    if ent and ent.get("key") == key and ent.get("vox_per_sec", 0) > 0:
+        base = float(ent["vox_per_sec"])
+    else:
+        grid = create_grid(bbox.shape, (64, 64, 32), (64, 64, 32))
+        t0 = time.time()
+        for t in range(n_tp):
+            for c in range(n_ch):
+                vws = _slot_views(sd, c, t)
+                for block in grid:
+                    bg = Interval.from_shape(block.size, block.offset
+                                             ).translate(bbox.min)
+                    _baseline_fuse_block(sd, loader, vws, bg)
+        bdt = time.time() - t0
+        base = vox / bdt
+        cache["multitp"] = {
+            "key": key, "vox_per_sec": round(base, 1), "voxels": vox,
+            "seconds": round(bdt, 3),
+            "method": ("reference-equivalent numpy fusion "
+                       "(_baseline_fuse_block) over all 4 (channel,"
+                       "timepoint) slots of the 5-D OME-ZARR config"),
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
+        _baseline_cache_store(cache)
+    # sanity: every slot landed with data
+    import numpy as _np
+    for t in range(n_tp):
+        for c in range(n_ch):
+            blk = _np.asarray(ds.read((0, 0, 0, c, t), (32, 32, 32, 1, 1)))
+            assert blk.std() > 0, f"slot c{c} t{t} empty"
+    return {
+        "metric": "multitp_omezarr_fusion_vox_per_sec",
+        "value": round(vox / dt, 1),
+        "unit": "voxel/s",
+        "slots": n_ch * n_tp,
+        "vs_baseline": round(vox / dt / base, 3),
+        "baseline_vox_per_sec": round(base, 1),
+    }
+
+
+NONRIGID_SPEC = {
+    "n_tiles": (2, 1, 1), "tile_size": (96, 96, 48), "overlap": 40,
+    "jitter": 3.0, "seed": 13, "n_beads_per_tile": 40,
+}
+
+
+def _np_nonrigid_volume(sd, loader, views, unique, bbox, cpd=10.0):
+    """Reference-equivalent CPU non-rigid fusion: per view, fit the
+    control-point grid (shared host-side fit), then per voxel interpolate the
+    12 model coefficients (scipy map_coordinates over the grid), deform the
+    world coordinate, trilinear-sample the view, cosine-blend and average
+    (NonRigidTools.fuseVirtualInterpolatedNonRigid role)."""
+    import numpy as np
+    from scipy.ndimage import map_coordinates
+
+    from bigstitcher_spark_tpu.ops.nonrigid import fit_control_grid
+    from bigstitcher_spark_tpu.utils.geometry import invert_affine
+
+    shape = tuple(bbox.shape)
+    origin = np.array(bbox.min, np.float64)
+    gdims = tuple(int(np.ceil(shape[d] / cpd)) + 2 for d in range(3))
+    gorigin = origin - cpd
+    axes = np.meshgrid(*[np.arange(s, dtype=np.float64) for s in shape],
+                       indexing="ij")
+    world = np.stack([a + origin[d] for d, a in enumerate(axes)])  # (3,X,Y,Z)
+    acc = np.zeros(shape, np.float64)
+    wsum = np.zeros(shape, np.float64)
+    for v in views:
+        targets = unique.targets[v]
+        vw = unique.view_world[v]
+        grid = fit_control_grid(targets, vw, gorigin, gdims, cpd)  # (G...,12)
+        gc = (world - gorigin[:, None, None, None]) / cpd
+        coef = np.stack([
+            map_coordinates(grid[..., k].astype(np.float64), gc, order=1,
+                            mode="nearest")
+            for k in range(12)
+        ])  # (12,X,Y,Z)
+        A = coef.reshape(3, 4, *shape)
+        deformed = (np.einsum("ij...,j...->i...", A[:, :3], world)
+                    + A[:, 3])
+        inv = invert_affine(sd.model(v))
+        local = (np.einsum("ij,j...->i...", inv[:, :3], deformed)
+                 + inv[:, 3][:, None, None, None])
+        img = loader.open(v, 0).read_full().astype(np.float64)
+        val = map_coordinates(img, local, order=1, mode="constant", cval=0.0)
+        dim = np.array(img.shape, np.float64)
+        w = np.ones(shape)
+        inside = np.ones(shape, bool)
+        for d in range(3):
+            dd = np.minimum(local[d], (dim[d] - 1.0) - local[d])
+            ramp = 0.5 * (np.cos((1.0 - dd / 40.0) * np.pi) + 1.0)
+            w = w * np.where(dd < 0, 0.0, np.where(dd < 40.0, ramp, 1.0))
+            inside &= (local[d] >= 0) & (local[d] <= dim[d] - 1.0)
+        w = w * inside
+        acc += val * w
+        wsum += w
+    return np.where(wsum > 0, acc / np.maximum(wsum, 1e-20), 0.0)
+
+
+def measure_nonrigid():
+    """Non-rigid fusion over the full volume (BASELINE.md config): detection
+    + matching stage the correspondences (untimed), then time
+    fuse_nonrigid_volume vs the numpy reference implementation."""
+    import numpy as np
+
+    from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+    from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+    from bigstitcher_spark_tpu.io.interestpoints import InterestPointStore
+    from bigstitcher_spark_tpu.io.spimdata import SpimData
+    from bigstitcher_spark_tpu.models.detection import (
+        DetectionParams, detect_interest_points, save_detections,
+    )
+    from bigstitcher_spark_tpu.models.matching import (
+        MatchingParams, match_interest_points, save_matches,
+    )
+    from bigstitcher_spark_tpu.models.nonrigid_fusion import (
+        build_unique_points, fuse_nonrigid_volume,
+    )
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+    from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+    root = os.path.join(FIXTURE, "nonrigid")
+    xml = os.path.join(root, "proj", "dataset.xml")
+    if not os.path.exists(xml):
+        make_synthetic_project(os.path.join(root, "proj"), **NONRIGID_SPEC)
+    sd = SpimData.load(xml)
+    loader = ViewLoader(sd)
+    views = sorted(sd.registrations)
+    store = InterestPointStore(os.path.join(root, "proj",
+                                            "interestpoints.n5"))
+    dets = detect_interest_points(
+        sd, loader, views,
+        DetectionParams(downsample_xy=1, downsample_z=1,
+                        block_size=(96, 96, 48)),
+        progress=False)
+    save_detections(sd, store, dets, DetectionParams())
+    mparams = MatchingParams(ransac_min_inliers=5, ransac_iterations=2000,
+                             model="TRANSLATION", regularization="NONE")
+    save_matches(sd, store,
+                 match_interest_points(sd, views, mparams, store,
+                                       progress=False),
+                 mparams, views)
+    unique = build_unique_points(sd, store, views, ["beads"])
+    bbox = maximal_bounding_box(sd, views, None)
+
+    out_path = os.path.join(root, "fused.n5")
+
+    def run():
+        shutil.rmtree(out_path, ignore_errors=True)
+        cstore = ChunkStore.create(out_path, StorageFormat.N5)
+        ds = cstore.create_dataset("fused", bbox.shape, (64, 64, 48),
+                                   "float32")
+        fuse_nonrigid_volume(
+            sd, loader, views, unique, ds, bbox, block_size=(64, 64, 48),
+            block_scale=(1, 1, 1), cpd=10.0, out_dtype="float32",
+            min_intensity=0.0, max_intensity=1.0)
+        return ds
+
+    run()  # warm compiles
+    t0 = time.time()
+    ds = run()
+    dt = time.time() - t0
+    vox = int(np.prod(bbox.shape))
+
+    cache = _baseline_cache_load()
+    key = _fixture_key(f"nonrigid-{NONRIGID_SPEC}")
+    ent = cache.get("nonrigid")
+    if ent and ent.get("key") == key and ent.get("vox_per_sec", 0) > 0:
+        base = float(ent["vox_per_sec"])
+    else:
+        t0 = time.time()
+        ref = _np_nonrigid_volume(sd, loader, views, unique, bbox)
+        bdt = time.time() - t0
+        base = vox / bdt
+        # validate the XLA output against the independent implementation
+        got = ds.read_full()
+        diff = np.abs(got.astype(np.float64) - ref)
+        assert float(np.median(diff)) < 0.02 * max(float(ref.max()), 1e-9), (
+            f"nonrigid XLA disagrees with numpy baseline: "
+            f"median|diff|={np.median(diff):.4f}")
+        cache["nonrigid"] = {
+            "key": key, "vox_per_sec": round(base, 1), "voxels": vox,
+            "seconds": round(bdt, 3),
+            "method": ("reference-equivalent numpy non-rigid fusion: shared "
+                       "MLS control-grid fit, scipy map_coordinates "
+                       "coefficient interpolation + deformation + trilinear "
+                       "sampling + cosine blend (NonRigidTools role)"),
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
+        _baseline_cache_store(cache)
+    return {
+        "metric": "nonrigid_fusion_vox_per_sec",
+        "value": round(vox / dt, 1),
+        "unit": "voxel/s",
+        "vs_baseline": round(vox / dt / base, 3),
+        "baseline_vox_per_sec": round(base, 1),
+    }
+
+
 def _log(msg):
     print(f"[bench:{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
           flush=True)
@@ -598,11 +869,13 @@ def child_main():
         "extra_metrics": [],
     }
     _checkpoint(result)
-    for name, fn in (("kernel", measure_kernel_only),
-                     ("phasecorr", measure_phasecorr),
-                     ("dog", measure_dog)):
+    for name, fn in (("kernel", lambda: measure_kernel_only(xml)),
+                     ("phasecorr", lambda: measure_phasecorr(xml)),
+                     ("dog", lambda: measure_dog(xml)),
+                     ("multitp", measure_multitp),
+                     ("nonrigid", measure_nonrigid)):
         try:
-            m = fn(xml)
+            m = fn()
         except Exception as e:  # a failed extra must not void the primary
             _log(f"{name} failed: {e!r}")
             m = {"metric": name, "error": repr(e)[:200]}
@@ -622,7 +895,7 @@ def _salvage_partial(partial_path, label):
     if res.get("metric") and res.get("value"):
         res["partial"] = True
         print(f"[bench] {label}: salvaged partial result "
-              f"(extras done: {len(res.get('extra_metrics', []))}/3)",
+              f"(extras done: {len(res.get('extra_metrics', []))}/5)",
               file=sys.stderr)
         return json.dumps(res)
     return None

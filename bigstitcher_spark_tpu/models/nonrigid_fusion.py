@@ -242,9 +242,14 @@ def fuse_nonrigid_volume(
     return stats
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=32)
 def _make_nonrigid_kernel(n_dev, compute_block, fusion_type, out_dtype):
     """Batch-of-blocks nonrigid fusion kernel with on-device intensity
-    conversion; batch axis sharded over the mesh when n_dev > 1."""
+    conversion; batch axis sharded over the mesh when n_dev > 1.
+    lru_cache'd: a fresh jax.jit per call would recompile every run."""
     import jax
 
     from ..ops.nonrigid import nonrigid_fuse_block_impl

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .fusion import _blend_weight, _combine_views, _trilinear_sample, block_coords
+from .fusion import _blend_weight, _combine_views, _trilinear_sample
 
 
 def _trilinear_vec(grid: jnp.ndarray, pts: jnp.ndarray) -> jnp.ndarray:
@@ -61,15 +61,37 @@ def _trilinear_vec(grid: jnp.ndarray, pts: jnp.ndarray) -> jnp.ndarray:
 
 def _sample_one_view_nonrigid(
     patch, grid, view_affine, patch_offset, img_dim, border, blend_range,
-    world_pts, grid_origin, grid_spacing,
+    block_origin, grid_origin, grid_spacing, block_shape,
 ):
     """Per view: deform world coords by the interpolated control-point model,
-    map into patch coords, sample + blend. Returns (val, inside, w_blend)."""
-    g = (world_pts - grid_origin) / grid_spacing          # grid units (N,3)
-    coef = _trilinear_vec(grid, g)                        # (N,12)
-    A = coef.reshape(-1, 3, 4)
-    deformed = jnp.einsum("nij,nj->ni", A[:, :, :3], world_pts) + A[:, :, 3]
-    p = deformed @ view_affine[:, :3].T + view_affine[:, 3]  # patch coords
+    map into patch coords, sample + blend. Returns (val, inside, w_blend).
+
+    The control-grid interpolation is SEPARABLE: output voxels form a regular
+    lattice, so their grid coordinates are affine per axis and the trilinear
+    interpolation of the (Gx,Gy,Gz,12) vertex models is the tensor product of
+    three 1-D interpolation matrices — three small GEMMs (MXU work) instead
+    of 8×12 gathers per voxel. Only the final patch sampling gathers (its
+    coordinates are data-dependent through the deformation)."""
+    from .fusion import _separable_interp_matrix
+
+    L = block_shape
+    so = grid  # (Gx,Gy,Gz,12)
+    for d in range(3):
+        pos = (block_origin[d] + jnp.arange(L[d], dtype=jnp.float32)
+               - grid_origin[d]) / grid_spacing[d]
+        m = _separable_interp_matrix(pos, grid.shape[d])
+        so = jnp.tensordot(so, m, axes=[[0], [1]])
+    A = so.reshape(3, 4, *L)  # per-voxel affine coefficients
+    wx = block_origin[0] + jnp.arange(L[0], dtype=jnp.float32)[:, None, None]
+    wy = block_origin[1] + jnp.arange(L[1], dtype=jnp.float32)[None, :, None]
+    wz = block_origin[2] + jnp.arange(L[2], dtype=jnp.float32)[None, None, :]
+    deformed = [A[i, 0] * wx + A[i, 1] * wy + A[i, 2] * wz + A[i, 3]
+                for i in range(3)]
+    p = jnp.stack([
+        (view_affine[i, 0] * deformed[0] + view_affine[i, 1] * deformed[1]
+         + view_affine[i, 2] * deformed[2] + view_affine[i, 3]).ravel()
+        for i in range(3)
+    ], axis=-1)  # (N,3) patch coords
     val = _trilinear_sample(patch, p)
     lpos = p + patch_offset
     inside = jnp.all(
@@ -96,12 +118,13 @@ def nonrigid_fuse_block_impl(
 ):
     """Fuse one output block under per-view non-rigid deformation.
     Returns (fused, weight-sum) blocks."""
-    world = block_coords(block_shape) + block_origin
+    def one(*args):
+        return _sample_one_view_nonrigid(*args, block_shape=block_shape)
+
     vals, insides, wblends = jax.vmap(
-        _sample_one_view_nonrigid,
-        in_axes=(0, 0, 0, 0, 0, 0, 0, None, None, None),
+        one, in_axes=(0, 0, 0, 0, 0, 0, 0, None, None, None),
     )(patches, grids, view_affines, patch_offsets, img_dims, borders,
-      blend_ranges, world, grid_origin, grid_spacing)
+      blend_ranges, block_origin, grid_origin, grid_spacing)
     fused, wsum = _combine_views(vals, insides, wblends, valid, fusion_type)
     return fused.reshape(block_shape), wsum.reshape(block_shape)
 

@@ -18,6 +18,7 @@ out).
 
 from __future__ import annotations
 
+import functools
 
 from typing import Sequence
 
@@ -31,13 +32,24 @@ from ..ops import fusion as F
 BLOCK_AXIS = "blocks"
 
 
-def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
-    devs = list(devices if devices is not None else jax.devices())
+@functools.lru_cache(maxsize=8)
+def _cached_mesh(n_devices: int | None) -> Mesh:
+    devs = list(jax.devices())
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (BLOCK_AXIS,))
 
 
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    # cached per device count: a stable Mesh identity lets the jitted fuser
+    # cache (make_sharded_fuser) hit across volumes/runs instead of
+    # recompiling per call
+    if devices is not None:
+        return Mesh(np.array(list(devices)), (BLOCK_AXIS,))
+    return _cached_mesh(n_devices)
+
+
+@functools.lru_cache(maxsize=64)
 def make_sharded_fuser(
     mesh: Mesh,
     block_shape: tuple[int, int, int],
@@ -48,6 +60,9 @@ def make_sharded_fuser(
     masks: bool = False,
 ):
     """Compile a fuser for a BATCH of blocks sharded over the mesh.
+
+    lru_cache'd so repeated volumes (multi-channel/timepoint loops, repeated
+    runs) reuse the jitted callable instead of recompiling per call.
 
     Inputs get a leading batch axis B (a multiple of mesh size; pad with
     valid=0 blocks). Returns ``fn(*arrays) -> (out (B,*block_shape), wsum)``
