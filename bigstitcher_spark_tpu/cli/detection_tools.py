@@ -49,6 +49,9 @@ from .common import (
               help="keep only the brightest N spots per view (0 = all)")
 @click.option("--maxSpotsPerOverlap", "max_spots_per_overlap", is_flag=True,
               help="distribute --maxSpots over overlap regions by volume")
+@click.option("--keepTemporaryN5", "keep_temporary_n5", is_flag=True,
+              default=False, expose_value=False,
+              help="accepted for compatibility: this implementation compacts detections on device and never stages a temporary N5")
 @click.option("--storeIntensities", "store_intensities", is_flag=True,
               help="sample + store per-point image intensities")
 @click.option("--medianFilter", "median_radius", default=0, type=int,
@@ -122,15 +125,15 @@ def detect_interestpoints_cmd(xml, dry_run, **kw):
                                  "ALL_TO_ALL_WITH_RANGE", "REFERENCE_TIMEPOINT"]))
 @click.option("--referenceTP", "reference_tp", default=0, type=int)
 @click.option("--rangeTP", "range_tp", default=5, type=int)
-@click.option("--significance", "ratio_of_distance", default=3.0, type=float,
+@click.option("-s", "--significance", "ratio_of_distance", default=3.0, type=float,
               help="descriptor ratio-of-distance threshold")
-@click.option("--numNeighbors", "n_neighbors", default=3, type=int)
-@click.option("--redundancy", "redundancy", default=1, type=int)
-@click.option("--ransacIterations", default=10000, type=int)
+@click.option("-n", "--numNeighbors", "n_neighbors", default=3, type=int)
+@click.option("-r", "--redundancy", "redundancy", default=1, type=int)
+@click.option("-rit", "--ransacIterations", "ransaciterations", default=10000, type=int)
 @click.option("-rme", "--ransacMaxError", "--ransacMaxEpsilon",
               "ransacmaxepsilon", default=5.0, type=float)
-@click.option("--ransacMinInlierRatio", default=0.1, type=float)
-@click.option("--ransacMinNumInliers", default=12, type=int)
+@click.option("-rmir", "--ransacMinInlierRatio", "ransacmininlierratio", default=0.1, type=float)
+@click.option("-rmni", "--ransacMinNumInliers", "ransacminnuminliers", default=12, type=int)
 @click.option("-rmc", "--ransacMultiConsensus", "ransac_multi", is_flag=True,
               default=False,
               help="ransac performs multiconsensus matching")
@@ -149,6 +152,11 @@ def detect_interestpoints_cmd(xml, dry_run, **kw):
               help="which view pairs to match")
 @click.option("--interestPointsForOverlapOnly", "overlap_only_points",
               is_flag=True, help="match only points inside the pair overlap")
+@click.option("-ipfr", "--interestpointsForReg", "ipfr", default=None,
+              type=click.Choice(["ALL", "OVERLAPPING_ONLY"]),
+              help="which interest points to use for pairwise registrations "
+                   "(reference -ipfr; OVERLAPPING_ONLY is equivalent to "
+                   "--interestPointsForOverlapOnly)")
 @click.option("--clearCorrespondences", "clear_corrs", is_flag=True,
               help="drop existing correspondences instead of merging")
 @click.option("--groupTiles", "group_tiles", is_flag=True,
@@ -193,7 +201,8 @@ def match_interestpoints_cmd(xml, dry_run, **kw):
         overlap_filter=kw["view_reg"] == "OVERLAPPING_ONLY",
         registration_tp=kw["registration_tp"],
         reference_tp=kw["reference_tp"], range_tp=kw["range_tp"],
-        interest_points_for_overlap_only=kw["overlap_only_points"],
+        interest_points_for_overlap_only=(kw["overlap_only_points"]
+            or kw.get("ipfr") == "OVERLAPPING_ONLY"),
         clear_correspondences=kw["clear_corrs"],
         group_tiles=kw["group_tiles"], group_channels=kw["group_channels"],
         group_illums=kw["group_illums"],

@@ -51,7 +51,7 @@ _DTYPES = ("UINT8", "UINT16", "FLOAT32")
 @xml_option
 @view_selection_options
 @infrastructure_options
-@click.option("-o", "--output", "output", required=True,
+@click.option("-o", "--outputPath", "--output", "output", required=True,
               help="output container path (.n5 / .zarr)")
 @click.option("-s", "--storage", type=click.Choice(["N5", "ZARR", "HDF5"]),
               default="ZARR", help="storage format")
@@ -59,9 +59,17 @@ _DTYPES = ("UINT8", "UINT16", "FLOAT32")
               type=click.Choice(_DTYPES), default="FLOAT32")
 @click.option("--blockSize", "block_size", default="128,128,128",
               help="block size, e.g. 128,128,64")
+@click.option("-ch", "--numChannels", "num_channels_opt", type=int,
+              default=None,
+              help="number of container channels (default: from the XML "
+                   "view selection)")
+@click.option("-tp", "--numTimepoints", "num_timepoints_opt", type=int,
+              default=None,
+              help="number of container timepoints (default: from the XML "
+                   "view selection)")
 @click.option("--bdv", is_flag=True, default=False,
               help="write a BDV-project layout (+XML) instead of a plain container")
-@click.option("--xmlout", "xml_out", default=None,
+@click.option("-xo", "--xmlout", "xml_out", default=None,
               help="output XML path for --bdv")
 @click.option("--multiRes", "multi_res", is_flag=True, default=False,
               help="automatically create a multiresolution pyramid")
@@ -73,15 +81,21 @@ _DTYPES = ("UINT8", "UINT16", "FLOAT32")
               default=float("nan"))
 @click.option("--minIntensity", "min_intensity", type=float, default=None)
 @click.option("--maxIntensity", "max_intensity", type=float, default=None)
-@click.option("--boundingBox", "bounding_box", default=None,
+@click.option("-b", "--boundingBox", "bounding_box", default=None,
               help="use a named bounding box from the XML instead of the maximal one")
-@click.option("--compression", default="zstd",
+@click.option("-c", "--compression", default="zstd",
               type=click.Choice(["zstd", "gzip", "raw", "blosc", "bzip2", "xz"]))
+@click.option("-cl", "--compressionLevel", "compression_level", type=int,
+              default=None,
+              help="codec-specific compression level (CreateFusionContainer "
+                   "-cl)")
 def create_fusion_container_cmd(xml, output, storage, data_type, block_size,
+                                num_channels_opt, num_timepoints_opt,
                                 bdv, xml_out, multi_res, downsampling,
                                 preserve_anisotropy, anisotropy_factor,
                                 min_intensity, max_intensity, bounding_box,
-                                compression, dry_run, **kwargs):
+                                compression, compression_level, dry_run,
+                                **kwargs):
     """Create an empty fusion output container + metadata (driver-only)."""
     sd = SpimData.load(xml)
     views = select_views_from_kwargs(sd, kwargs)
@@ -89,10 +103,15 @@ def create_fusion_container_cmd(xml, output, storage, data_type, block_size,
     if compression == "xz" and storage_format != StorageFormat.N5:
         raise click.ClickException(
             "xz compression is only available for N5 containers")
+    if compression_level is not None:
+        compression = f"{compression}:{compression_level}"
 
     channels = sorted({sd.setups[v.setup].attributes.get("channel", 0) for v in views})
     tps = sorted({v.timepoint for v in views})
-    num_channels, num_timepoints = len(channels), len(tps)
+    num_channels = (num_channels_opt if num_channels_opt is not None
+                    else len(channels))
+    num_timepoints = (num_timepoints_opt if num_timepoints_opt is not None
+                      else len(tps))
 
     if preserve_anisotropy and not np.isfinite(anisotropy_factor):
         anisotropy_factor = anisotropy_factor_from_voxel_sizes(sd, views)
@@ -239,10 +258,14 @@ def _write_bdv_output_xml(xml_out: str, container: str, meta, storage_format) ->
 
 @click.command()
 @infrastructure_options
-@click.option("-o", "--output", "output", required=True,
+@click.option("-o", "--n5Path", "--output", "output", required=True,
               help="fusion container created by create-fusion-container")
+@click.option("-s", "--storage", "storage_opt", default=None,
+              type=click.Choice(["N5", "ZARR", "HDF5"]),
+              help="container storage format (validated against the "
+                   "container's own metadata)")
 @view_selection_options
-@click.option("--fusionType", "fusion_type",
+@click.option("-f", "--fusion", "--fusionType", "fusion_type",
               type=click.Choice(FUSION_TYPES, case_sensitive=False),
               default="AVG_BLEND")
 @click.option("--blockScale", "block_scale", default="2,2,1",
@@ -252,20 +275,30 @@ def _write_bdv_output_xml(xml_out: str, container: str, meta, storage_format) ->
 @click.option("--maskOffset", "mask_offset", default="0.0,0.0,0.0")
 @click.option("--blendingRange", "blending_range", default="40,40,40")
 @click.option("--blendingBorder", "blending_border", default="0,0,0")
-@click.option("--channelIndex", "channel_index", type=int, default=None,
+@click.option("-c", "--channelIndex", "channel_index", type=int, default=None,
               help="process only this channel index of the container")
-@click.option("--timepointIndex", "timepoint_index", type=int, default=None,
+@click.option("-t", "--timepointIndex", "timepoint_index", type=int,
+              default=None,
               help="process only this timepoint index of the container")
+@click.option("--prefetch/--no-prefetch", "prefetch", default=True,
+              help="prefetch source chunks ahead of the kernel (always on in "
+                   "this implementation's host IO pipeline; --no-prefetch "
+                   "serializes IO for debugging)")
 @click.option("--intensityN5", "intensity_n5", default=None, is_flag=False,
               flag_value="",
               help="apply solved intensity coefficients (optionally give the "
                    "N5 path; default: intensity.n5 next to the input XML)")
-def affine_fusion_cmd(output, fusion_type, block_scale, masks, mask_offset,
-                      blending_range, blending_border, channel_index,
-                      timepoint_index, intensity_n5, dry_run, **kwargs):
+def affine_fusion_cmd(output, storage_opt, fusion_type, block_scale, masks,
+                      mask_offset, blending_range, blending_border,
+                      channel_index, timepoint_index, prefetch, intensity_n5,
+                      dry_run, **kwargs):
     """Fuse all views into the prepared container (THE workload)."""
     t_start = time.time()
     store = open_container(output)
+    if storage_opt is not None and store.format != StorageFormat(storage_opt):
+        raise click.ClickException(
+            f"--storage {storage_opt} does not match the container at "
+            f"{output} ({store.format.name})")
     try:
         meta = read_container_meta(store)
     except ValueError as e:
@@ -340,6 +373,7 @@ def affine_fusion_cmd(output, fusion_type, block_scale, masks, mask_offset,
                 mask_offset=moff,
                 zarr_ct=(ci, ti) if is_zarr5d else None,
                 coefficients=coefficients,
+                io_threads=4 if prefetch else 1,
             )
             total_vox += stats.voxels
             click.echo(f"  {stats.voxels} voxels in {stats.seconds:.2f}s "
@@ -366,10 +400,15 @@ def _write_pyramid(store, mr_levels, is_zarr5d, ct):
 
 @click.command()
 @infrastructure_options
-@click.option("-o", "--output", "output", required=True,
-              help="fusion container created by create-fusion-container")
+@click.option("-o", "--n5Path", "--output", "output", required=True,
+              help="fusion container created by create-fusion-container, or "
+                   "a fresh path with -x/--dataType (direct-output mode)")
+@click.option("-x", "--xml", "xml", default=None,
+              help="dataset XML (direct-output mode only; containers carry "
+                   "their InputXML)")
 @view_selection_options
-@click.option("-l", "--label", "labels", multiple=True, default=("beads",),
+@click.option("-ip", "--interestPoints", "-l", "--label", "labels",
+              multiple=True, default=("beads",),
               help="interest point label(s) defining the deformation")
 @click.option("-cpd", "--controlPointDistance", "cpd", type=float, default=10.0,
               help="control point grid spacing in px")
@@ -383,9 +422,28 @@ def _write_pyramid(store, mr_levels, is_zarr5d, ct):
 @click.option("--blendingBorder", "blending_border", default="0,0,0")
 @click.option("--channelIndex", "channel_index", type=int, default=None)
 @click.option("--timepointIndex", "timepoint_index", type=int, default=None)
-def nonrigid_fusion_cmd(output, labels, cpd, alpha, fusion_type, block_scale,
-                        blending_range, blending_border, channel_index,
-                        timepoint_index, dry_run, **kwargs):
+@click.option("-s", "--storage", "storage_opt", default=None,
+              type=click.Choice(["N5", "ZARR", "HDF5"]),
+              help="storage format for direct-output mode (default ZARR)")
+@click.option("-d", "--n5Dataset", "n5_dataset", default=None,
+              help="accepted for compatibility; the container layout fixes "
+                   "the dataset names")
+@click.option("-p", "--dataType", "data_type", default=None,
+              type=click.Choice(_DTYPES),
+              help="output data type (direct-output mode)")
+@click.option("--minIntensity", "min_intensity", type=float, default=None)
+@click.option("--maxIntensity", "max_intensity", type=float, default=None)
+@click.option("-b", "--boundingBox", "bounding_box", default=None,
+              help="named bounding box (direct-output mode)")
+@click.option("--bdv", is_flag=True, default=False,
+              help="also write a BDV project XML (direct-output mode)")
+@click.option("-xo", "--xmlout", "xml_out", default=None,
+              help="output XML path for --bdv (direct-output mode)")
+def nonrigid_fusion_cmd(output, xml, labels, cpd, alpha, fusion_type,
+                        block_scale, blending_range, blending_border,
+                        channel_index, timepoint_index, storage_opt,
+                        n5_dataset, data_type, min_intensity, max_intensity,
+                        bounding_box, bdv, xml_out, dry_run, **kwargs):
     """Distributed non-rigid fusion driven by corresponding interest points
     (SparkNonRigidFusion)."""
     from ..io.interestpoints import InterestPointStore
@@ -395,11 +453,41 @@ def nonrigid_fusion_cmd(output, labels, cpd, alpha, fusion_type, block_scale,
     )
 
     t_start = time.time()
-    store = open_container(output)
     try:
+        store = open_container(output)
         meta = read_container_meta(store)
-    except ValueError as e:
-        raise click.ClickException(str(e)) from e
+    except (ValueError, FileNotFoundError) as e:
+        # direct-output mode (the reference's SparkNonRigidFusion writes
+        # straight to an N5/ZARR, no create-fusion-container step): create
+        # the container here from -x/--dataType/--boundingBox
+        if xml is None or data_type is None:
+            raise click.ClickException(
+                f"{output} is not a fusion container ({e}); for direct "
+                "output pass -x <dataset.xml> and -p/--dataType "
+                "(plus optionally -s, -b, --minIntensity/--maxIntensity, "
+                "--bdv/-xo)") from e
+        from click.testing import CliRunner
+
+        args = ["-x", xml, "-o", output,
+                "-s", storage_opt or "ZARR", "-d", data_type]
+        if bounding_box is not None:
+            args += ["-b", bounding_box]
+        if min_intensity is not None:
+            args += ["--minIntensity", str(min_intensity)]
+        if max_intensity is not None:
+            args += ["--maxIntensity", str(max_intensity)]
+        if bdv:
+            args += ["--bdv"]
+            if xml_out:
+                args += ["-xo", xml_out]
+        r = CliRunner().invoke(create_fusion_container_cmd, args,
+                               catch_exceptions=False)
+        if r.exit_code != 0:
+            raise click.ClickException(
+                f"direct-output container creation failed: {r.output}")
+        click.echo(f"direct output: created container at {output}")
+        store = open_container(output)
+        meta = read_container_meta(store)
     sd = SpimData.load(meta.input_xml)
     loader = ViewLoader(sd)
     all_views = select_views_from_kwargs(sd, kwargs)

@@ -1,5 +1,7 @@
 """CLI: match-intensities and solve-intensities (reference tools
-SparkIntensityMatching.java / IntensitySolver.java)."""
+SparkIntensityMatching.java / IntensitySolver.java). Option spellings mirror
+the reference exactly, with a few extra local aliases kept for
+backwards-compatibility with earlier rounds of this repo."""
 
 from __future__ import annotations
 
@@ -19,19 +21,46 @@ from .common import (
 @xml_option
 @view_selection_options
 @infrastructure_options
-@click.option("--coefficients", "coefficients", default="8,8,8",
-              help="coefficient grid cells per view, e.g. 8,8,8")
+@click.option("--numCoefficients", "--coefficients", "coefficients",
+              default="8,8,8",
+              help="number of coefficients per dimension (default: 8,8,8)")
 @click.option("--renderScale", "render_scale", type=float, default=0.25,
-              help="sampling scale inside overlaps")
+              help="at which scale to sample images (default: 0.25)")
 @click.option("-m", "--method", type=click.Choice(["RANSAC", "HISTOGRAM"]),
               default="RANSAC")
-@click.option("--ransacEpsilon", "ransac_epsilon", type=float, default=0.02)
-@click.option("--ransacIterations", "ransac_iterations", type=int, default=1000)
+@click.option("--maxEpsilon", "--ransacEpsilon", "ransac_epsilon", type=float,
+              default=0.02,
+              help="maximal allowed transfer error relative to the "
+                   "[0,1]-normalized intensity range (default: 0.02 — the "
+                   "reference's 5.1 of 255)")
+@click.option("--numIterations", "--ransacIterations", "ransac_iterations",
+              type=int, default=1000,
+              help="number of RANSAC iterations (default: 1000)")
 @click.option("--minSamples", "min_samples", type=int, default=10)
-@click.option("--intensityN5", "intensity_n5", default=None,
-              help="output N5 (default: intensity.n5 next to the XML)")
+@click.option("--minThreshold", "min_threshold", type=float, default=1.0,
+              help="discard intensities below this value (default: 1)")
+@click.option("--maxThreshold", "max_threshold", type=float,
+              default=float("nan"),
+              help="discard intensities above this value (default: none)")
+@click.option("--minNumCandidates", "min_num_candidates", type=int,
+              default=1000,
+              help="minimum overlapping samples per coefficient-cell pair "
+                   "(default: 1000)")
+@click.option("--minInlierRatio", "min_inlier_ratio", type=float, default=0.1,
+              help="minimal inliers/candidates ratio (default: 0.1, RANSAC)")
+@click.option("--minNumInliers", "min_num_inliers", type=int, default=10,
+              help="minimally required inliers (default: 10, RANSAC)")
+@click.option("--maxTrust", "max_trust", type=float, default=3.0,
+              help="reject candidates with residual > maxTrust * median "
+                   "(default: 3, RANSAC)")
+@click.option("-o", "--outputPath", "--intensityN5", "intensity_n5",
+              default=None,
+              help="output N5 for pairwise matches (default: intensity.n5 "
+                   "next to the XML)")
 def match_intensities_cmd(xml, dry_run, coefficients, render_scale, method,
                           ransac_epsilon, ransac_iterations, min_samples,
+                          min_threshold, max_threshold, min_num_candidates,
+                          min_inlier_ratio, min_num_inliers, max_trust,
                           intensity_n5, **kw):
     """Pairwise per-cell intensity matching (SparkIntensityMatching)."""
     from ..io.dataset_io import ViewLoader
@@ -49,6 +78,10 @@ def match_intensities_cmd(xml, dry_run, coefficients, render_scale, method,
         render_scale=render_scale, method=method,
         ransac_epsilon=ransac_epsilon, ransac_iterations=ransac_iterations,
         min_samples_per_cell=min_samples,
+        min_threshold=min_threshold, max_threshold=max_threshold,
+        min_num_candidates=min_num_candidates,
+        min_inlier_ratio=min_inlier_ratio, min_num_inliers=min_num_inliers,
+        max_trust=max_trust,
     )
     matches = match_intensities(sd, loader, views, params)
     print(f"matched {len(matches)} coefficient-cell pairs")
@@ -67,21 +100,56 @@ def match_intensities_cmd(xml, dry_run, coefficients, render_scale, method,
 @infrastructure_options
 @click.option("--lambda", "lam", type=float, default=0.1,
               help="regularization toward identity")
-@click.option("--intensityN5", "intensity_n5", default=None,
-              help="N5 with matches (default: intensity.n5 next to the XML)")
-def solve_intensities_cmd(xml, dry_run, lam, intensity_n5, **kw):
+@click.option("--numCoefficients", "num_coefficients", default=None,
+              help="expected coefficients per dimension; validated against "
+                   "the stored matches")
+@click.option("--matchesPath", "matches_path", default=None,
+              help="N5 with pairwise matches (default: the intensity N5)")
+@click.option("--maxIterations", "max_iterations", type=int, default=2000,
+              help="accepted for compatibility: this implementation solves "
+                   "the global system exactly, no iteration limit applies")
+@click.option("-o", "--intensityN5Path", "--intensityN5", "intensity_n5",
+              default=None,
+              help="N5 for matches/coefficients (default: intensity.n5 next "
+                   "to the XML)")
+@click.option("-s", "--intensityN5Storage", "intensity_storage", default=None,
+              help="storage format of the intensity N5 (inferred from the "
+                   "path; validated when given)")
+@click.option("--intensityN5Group", "intensity_group", default=None,
+              help="group inside the N5 holding coefficients (default: "
+                   "coefficients)")
+@click.option("--intensityN5Dataset", "intensity_dataset", default=None,
+              help="dataset name for each view's coefficients (default: "
+                   "coefficients)")
+def solve_intensities_cmd(xml, dry_run, lam, num_coefficients, matches_path,
+                          max_iterations, intensity_n5, intensity_storage,
+                          intensity_group, intensity_dataset, **kw):
     """Global solve of per-view intensity coefficient grids (IntensitySolver)."""
     from ..models.intensity import IntensityStore, solve_intensities
 
     sd = load_project(xml)
     views = select_views_from_kwargs(sd, kw)
-    store = (IntensityStore(intensity_n5) if intensity_n5
+    match_root = matches_path or intensity_n5
+    store = (IntensityStore(match_root) if match_root
              else IntensityStore.for_project(sd))
+    if intensity_storage and not store.store.format.name.lower().startswith(
+            intensity_storage.lower().replace("ome-", "")):
+        raise click.ClickException(
+            f"--intensityN5Storage {intensity_storage} does not match the "
+            f"store at {store.root} ({store.store.format.name})")
     matches = store.load_all_matches()
     dims = store.coefficient_dims()
     if not matches or dims is None:
         raise click.ClickException(
             f"no intensity matches in {store.root}; run match-intensities first")
+    if num_coefficients is not None:
+        from .common import parse_csv_ints as _pci
+
+        want = tuple(_pci(num_coefficients, 3))
+        if want != tuple(dims):
+            raise click.ClickException(
+                f"--numCoefficients {want} does not match the stored matches "
+                f"({tuple(dims)})")
     coeffs = solve_intensities(matches, views, dims, lam)
     if dry_run:
         for v, c in sorted(coeffs.items()):
@@ -89,6 +157,9 @@ def solve_intensities_cmd(xml, dry_run, lam, intensity_n5, **kw):
                   f" offset [{c[..., 1].min():.1f}, {c[..., 1].max():.1f}]")
         print("dryRun: not saving")
         return
+    out_store = (IntensityStore(intensity_n5)
+                 if intensity_n5 and intensity_n5 != match_root else store)
     for v, c in coeffs.items():
-        store.save_coefficients(v, c)
-    print(f"saved coefficients for {len(coeffs)} views to {store.root}")
+        out_store.save_coefficients(v, c, group=intensity_group,
+                                    dataset=intensity_dataset)
+    print(f"saved coefficients for {len(coeffs)} views to {out_store.root}")

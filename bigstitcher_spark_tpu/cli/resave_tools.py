@@ -65,11 +65,17 @@ def parse_pyramid(spec_list) -> list[list[int]] | None:
               help="pyramid steps incl. 1,1,1, e.g. '1,1,1; 2,2,1; 4,4,1'")
 @click.option("-c", "--compression", default="zstd",
               type=click.Choice(["zstd", "gzip", "raw", "blosc", "bzip2", "xz"]))
+@click.option("-cl", "--compressionLevel", "compression_level", type=int,
+              default=None,
+              help="codec-specific compression level (SparkResaveN5 -cl)")
 @click.option("--threads", type=int, default=8,
               help="host IO threads for block copy")
 def resave_cmd(xml, xml_out, out_path, as_n5, block_size, block_scale,
-               downsampling, compression, threads, dry_run, **kwargs):
+               downsampling, compression, compression_level, dry_run,
+               threads, **kwargs):
     """Re-save the project into a chunked multi-res container."""
+    if compression_level is not None:
+        compression = f"{compression}:{compression_level}"
     sd = SpimData.load(xml)
     loader = ViewLoader(sd)
     views = select_views_from_kwargs(sd, kwargs)
@@ -117,11 +123,20 @@ def resave_cmd(xml, xml_out, out_path, as_n5, block_size, block_scale,
               help="output dataset(s), ';'-separated, e.g. /ch488/s1;/ch488/s2")
 @click.option("-ds", "--downsampling", "downsampling", required=True,
               help="consecutive steps, ';'-separated, e.g. '2,2,1; 2,2,1; 2,2,2'")
+@click.option("-s", "--storage", "storage_opt", default=None,
+              type=click.Choice(["N5", "ZARR", "HDF5"]),
+              help="container storage format (validated against the path)")
 @click.option("--blockScale", "block_scale", default="1,1,1")
 @click.option("--threads", type=int, default=8)
 def downsample_cmd(path_in, dataset_in, datasets_out, downsampling,
-                   block_scale, threads, dry_run):
+                   storage_opt, block_scale, threads, dry_run):
     """Chained 2x downsampling of an existing dataset (pyramid levels)."""
+    if storage_opt is not None:
+        fmt = ChunkStore.open(path_in).format
+        if fmt != StorageFormat(storage_opt):
+            raise click.ClickException(
+                f"--storage {storage_opt} does not match the container at "
+                f"{path_in} ({fmt.name})")
     store = ChunkStore.open(path_in)
     src_path = dataset_in.strip("/")
     steps = parse_pyramid([downsampling])

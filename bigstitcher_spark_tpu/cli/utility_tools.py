@@ -23,7 +23,8 @@ from .common import (
 @infrastructure_options
 @click.option("-l", "--label", default=None,
               help="only this interest point label (default: all labels)")
-@click.option("--onlyCorrespondences", "only_corrs", is_flag=True,
+@click.option("--correspondencesOnly", "--onlyCorrespondences", "only_corrs",
+              is_flag=True,
               help="delete only correspondences, keep the points")
 def clear_interestpoints_cmd(xml, dry_run, label, only_corrs, **kw):
     """Delete interest points (or only correspondences) from XML + store
@@ -139,23 +140,35 @@ def transform_points_cmd(xml, dry_run, vi, points, csv_in, csv_out):
 @click.command()
 @xml_option
 @infrastructure_options
-@click.option("--xmlout", "xml_out", default=None,
+@click.option("-xo", "--xmlout", "xml_out", default=None,
               help="output XML (default: overwrite input)")
-@click.option("-s", "--targetSize", "target_size", default="4000,4000,2000",
+@click.option("-tis", "--targetImageSize", "-s", "--targetSize",
+              "target_size", default="4000,4000,2000",
               help="target sub-image size x,y,z (SplitDatasets defaults)")
-@click.option("-o", "--targetOverlap", "target_overlap", default="200,200,100",
+@click.option("-to", "-o", "--targetOverlap", "target_overlap",
+              default="200,200,100",
               help="target sub-image overlap x,y,z")
+@click.option("--disableOptimization", "disable_optimization", is_flag=True,
+              help="use the target size/overlap exactly instead of the "
+                   "closest larger divisible-by-downsampling sizes")
 @click.option("--assignIlluminations", "assign_illums", is_flag=True,
               help="store old tile ids as illumination ids")
-@click.option("--fakeInterestPoints", "fake_ips", is_flag=True,
+@click.option("-fip", "--fakeInterestPoints", "fake_ips", is_flag=True,
               help="plant corresponding fake points in split overlaps")
 @click.option("--fipDensity", "fip_density", type=float, default=100.0)
 @click.option("--fipMinNumPoints", "fip_min", type=int, default=20)
 @click.option("--fipMaxNumPoints", "fip_max", type=int, default=500)
 @click.option("--fipError", "fip_error", type=float, default=0.5)
+@click.option("--fipExclusionRadius", "fip_exclusion_radius", type=float,
+              default=20.0,
+              help="minimum distance between planted fake points")
+@click.option("--displayResult", "display_result", is_flag=True,
+              help="GUI preview is unavailable headless: prints the split "
+                   "layout instead")
 def split_images_cmd(xml, dry_run, xml_out, target_size, target_overlap,
-                     assign_illums, fake_ips, fip_density, fip_min, fip_max,
-                     fip_error):
+                     disable_optimization, assign_illums, fake_ips,
+                     fip_density, fip_min, fip_max, fip_error,
+                     fip_exclusion_radius, display_result):
     """Virtually split large tiles into overlapping sub-tiles
     (SplitDatasets / SplittingTools.splitImages)."""
     from ..io.dataset_io import ViewLoader
@@ -173,7 +186,16 @@ def split_images_cmd(xml, dry_run, xml_out, target_size, target_overlap,
         fake_interest_points=fake_ips,
         fip_density=fip_density, fip_min=fip_min, fip_max=fip_max,
         fip_error=fip_error, fip_store=store,
+        fip_exclusion_radius=fip_exclusion_radius,
+        optimize=not disable_optimization,
     )
+    if display_result:
+        for sid in sorted(new_sd.setups):
+            su = new_sd.setups[sid]
+            src = new_sd.split_info.get(sid)
+            print(f"  setup {sid}: size {su.size}"
+                  + (f" <- source setup {src[0]} @ offset {tuple(src[1])}"
+                     if src is not None else ""))
     print(f"split {len(sd.setups)} setups into {len(new_sd.setups)} sub-views")
     if dry_run:
         print("dryRun: not saving")

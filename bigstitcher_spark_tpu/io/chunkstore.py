@@ -58,35 +58,53 @@ _ZARR_DTYPE = {
 }
 
 
-def _n5_compression(name: str) -> dict:
+def _split_level(name: str, level: int | None):
+    """Compression specs may carry the reference's --compressionLevel inline
+    as ``name:level`` (e.g. ``zstd:7``) so the spelling passes unchanged
+    through every layer that forwards a compression string."""
+    if ":" in name:
+        name, lv = name.split(":", 1)
+        if level is None:
+            level = int(lv)
+    return name, level
+
+
+def _n5_compression(name: str, level: int | None = None) -> dict:
     """N5 codec factory (reference surface: Lz4/Gzip/Zstd/Blosc/Bzip2/Xz/Raw,
-    util/N5Util.java:82-105; lz4 has no tensorstore n5 codec)."""
-    name = name.lower()
+    util/N5Util.java:82-105; lz4 has no tensorstore n5 codec). ``level`` is
+    the reference's --compressionLevel (codec-specific meaning)."""
+    name, level = _split_level(name.lower(), level)
     if name == "zstd":
-        return {"type": "zstd"}
+        return {"type": "zstd"} if level is None else {
+            "type": "zstd", "level": int(level)}
     if name == "gzip":
-        return {"type": "gzip"}
+        return {"type": "gzip"} if level is None else {
+            "type": "gzip", "level": int(level)}
     if name == "raw":
         return {"type": "raw"}
     if name == "blosc":
-        return {"type": "blosc", "cname": "zstd", "clevel": 3, "shuffle": 1}
+        return {"type": "blosc", "cname": "zstd",
+                "clevel": 3 if level is None else int(level), "shuffle": 1}
     if name == "bzip2":
-        return {"type": "bzip2"}
+        return {"type": "bzip2"} if level is None else {
+            "type": "bzip2", "blockSize": int(level)}
     if name == "xz":
-        return {"type": "xz"}
+        return {"type": "xz"} if level is None else {
+            "type": "xz", "preset": int(level)}
     raise ValueError(f"unsupported n5 compression: {name}")
 
 
-def _zarr_compressor(name: str) -> dict | None:
-    name = name.lower()
+def _zarr_compressor(name: str, level: int | None = None) -> dict | None:
+    name, level = _split_level(name.lower(), level)
     if name == "zstd":
-        return {"id": "zstd", "level": 3}
+        return {"id": "zstd", "level": 3 if level is None else int(level)}
     if name == "gzip":
-        return {"id": "zlib", "level": 5}
+        return {"id": "zlib", "level": 5 if level is None else int(level)}
     if name == "blosc":
-        return {"id": "blosc", "cname": "zstd", "clevel": 3, "shuffle": 1}
+        return {"id": "blosc", "cname": "zstd",
+                "clevel": 3 if level is None else int(level), "shuffle": 1}
     if name == "bzip2":
-        return {"id": "bz2", "level": 5}
+        return {"id": "bz2", "level": 5 if level is None else int(level)}
     if name == "raw":
         return None
     raise ValueError(f"unsupported zarr compression: {name}")
@@ -324,6 +342,7 @@ class ChunkStore:
         dtype: str | np.dtype,
         compression: str = "zstd",
         delete_existing: bool = False,
+        compression_level: int | None = None,
     ) -> Dataset:
         """Create a chunked dataset. ``shape``/``block_size`` xyz-first."""
         dtype = np.dtype(dtype).name
@@ -340,7 +359,7 @@ class ChunkStore:
                     "dimensions": list(shape),
                     "blockSize": list(block),
                     "dataType": dtype,
-                    "compression": _n5_compression(compression),
+                    "compression": _n5_compression(compression, compression_level),
                 },
                 "create": True,
                 "delete_existing": delete_existing,
@@ -352,7 +371,7 @@ class ChunkStore:
                 "shape": list(shape[::-1]),
                 "chunks": list(block[::-1]),
                 "dtype": _ZARR_DTYPE[dtype],
-                "compressor": _zarr_compressor(compression),
+                "compressor": _zarr_compressor(compression, compression_level),
             }
             spec = {
                 "driver": "zarr",
@@ -483,12 +502,15 @@ class Hdf5Store:
         if delete_existing and path in self._f:
             del self._f[path]
         kw = {}
+        compression, level = _split_level(compression, None)
         if compression not in ("raw", "gzip"):
             raise ValueError(
                 f"HDF5 store supports only gzip/raw compression, got {compression!r}"
             )
         if compression != "raw":
             kw["compression"] = "gzip"
+            if level is not None:
+                kw["compression_opts"] = int(level)
         d = self._f.create_dataset(
             path, shape=shape[::-1], chunks=block[::-1], dtype=np.dtype(dtype), **kw
         )

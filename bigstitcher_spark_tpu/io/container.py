@@ -234,7 +234,7 @@ def _create_fusion_container_hdf5(
     rel = _relative_steps(downsamplings)
     block_size = [int(b) for b in block_size]
     dt = np.dtype(data_type).name
-    if compression not in ("gzip", "raw"):
+    if compression.split(":")[0] not in ("gzip", "raw"):
         compression = "gzip"  # h5py codec surface (N5Util HDF5 writer role)
     fusion_format = "BDV/HDF5" if bdv else "HDF5"
 

@@ -48,6 +48,14 @@ class IntensityParams:
     min_samples_per_cell: int = 10
     lam: float = 0.1                  # solve regularization toward identity
     max_samples_per_cell: int = 2000
+    # reference candidate/inlier filters (SparkIntensityMatching.java:51-77)
+    min_threshold: float = 1.0        # --minThreshold: discard samples below
+    max_threshold: float = float("nan")  # --maxThreshold: discard above
+    min_num_candidates: int = 0       # --minNumCandidates per cell pair
+    min_inlier_ratio: float = 0.1     # --minInlierRatio (RANSAC)
+    min_num_inliers: int = 10         # --minNumInliers (RANSAC)
+    max_trust: float = 3.0            # --maxTrust: drop inliers with residual
+    #                                   > maxTrust * median residual
 
 
 @dataclass
@@ -106,6 +114,10 @@ def match_pair_intensities(
     ia, pa, in_a = _sample_view(sd, loader, va, world)
     ib, pb, in_b = _sample_view(sd, loader, vb, world)
     both = in_a & in_b & np.isfinite(ia) & np.isfinite(ib)
+    # intensity thresholds: discard candidates outside [min, max]
+    both &= (ia >= params.min_threshold) & (ib >= params.min_threshold)
+    if np.isfinite(params.max_threshold):
+        both &= (ia <= params.max_threshold) & (ib <= params.max_threshold)
     if not both.any():
         return []
     dims = params.coefficients
@@ -123,9 +135,10 @@ def match_pair_intensities(
     uniq, starts = np.unique(keys, axis=0, return_index=True)
     bounds = list(starts) + [len(order)]
     sa_list, sb_list, pairs = [], [], []
+    min_cand = max(params.min_samples_per_cell, params.min_num_candidates)
     for i, (cell_a, cell_b) in enumerate(uniq):
         sel = order[bounds[i]:bounds[i + 1]]
-        if len(sel) < params.min_samples_per_cell:
+        if len(sel) < min_cand:
             continue
         if len(sel) > params.max_samples_per_cell:
             sel = sel[:: len(sel) // params.max_samples_per_cell + 1]
@@ -151,9 +164,27 @@ def match_pair_intensities(
         a, b, _ = fit
         # inlier stats in ORIGINAL intensity units for the global solve
         x, y = xa[sel], xb[sel]
-        resid = np.abs(y / scale - (a * (x / scale) + b))
+        xn, yn = x / scale, y / scale
+        resid = np.abs(yn - (a * xn + b))
         inl = resid < 2.0 * params.ransac_epsilon
-        if inl.sum() < params.min_samples_per_cell:
+        # --maxTrust: iterative trim + REFIT (mpicbg filterRansac: drop
+        # candidates with residual > maxTrust * median, refit, repeat)
+        for _ in range(10):
+            if inl.sum() < 2:
+                break
+            A = np.stack([xn[inl], np.ones(int(inl.sum()))], axis=1)
+            (a, b), *_ = np.linalg.lstsq(A, yn[inl], rcond=None)
+            resid = np.abs(yn - (a * xn + b))
+            med = float(np.median(resid[inl]))
+            new_inl = inl & (resid <= max(params.max_trust * med,
+                                          1e-12))
+            if (new_inl == inl).all():
+                break
+            inl = new_inl
+        if inl.sum() < max(params.min_samples_per_cell,
+                           params.min_num_inliers):
+            continue
+        if inl.sum() < params.min_inlier_ratio * len(sel):
             continue
         out.append(CellMatch(
             va, vb, int(cell_a), int(cell_b),
@@ -262,19 +293,25 @@ class IntensityStore:
         d = self.store.get_attribute(MATCH_GROUP, "coefficientDims", None)
         return tuple(int(v) for v in d) if d else None
 
-    def save_coefficients(self, view: ViewId, coeffs: np.ndarray) -> None:
-        """coeffs (cx,cy,cz,2) -> dataset (2,cx,cy,cz)."""
-        path = (f"{COEFF_GROUP}/setup{view.setup}/timepoint{view.timepoint}"
-                f"/coefficients")
+    def save_coefficients(self, view: ViewId, coeffs: np.ndarray,
+                          group: str | None = None,
+                          dataset: str | None = None) -> None:
+        """coeffs (cx,cy,cz,2) -> dataset (2,cx,cy,cz). ``group``/``dataset``
+        override the default layout (--intensityN5Group/--intensityN5Dataset,
+        IntensitySolver.java)."""
+        path = (f"{group or COEFF_GROUP}/setup{view.setup}"
+                f"/timepoint{view.timepoint}/{dataset or 'coefficients'}")
         arr = np.moveaxis(coeffs, -1, 0).astype(np.float64)
         if self.store.exists(path):
             self.store.remove(path)
         ds = self.store.create_dataset(path, arr.shape, arr.shape, "float64")
         ds.write(arr, (0,) * arr.ndim)
 
-    def load_coefficients(self, view: ViewId) -> np.ndarray | None:
-        path = (f"{COEFF_GROUP}/setup{view.setup}/timepoint{view.timepoint}"
-                f"/coefficients")
+    def load_coefficients(self, view: ViewId,
+                          group: str | None = None,
+                          dataset: str | None = None) -> np.ndarray | None:
+        path = (f"{group or COEFF_GROUP}/setup{view.setup}"
+                f"/timepoint{view.timepoint}/{dataset or 'coefficients'}")
         if not self.store.is_dataset(path):
             return None
         arr = self.store.open_dataset(path).read_full()

@@ -66,13 +66,23 @@ def split_images(
     fip_error: float = 0.5,
     fip_store: InterestPointStore | None = None,
     rng_seed: int = 23,
+    fip_exclusion_radius: float = 0.0,
+    optimize: bool = True,
 ) -> SpimData:
-    """Build a new virtually-split project (the input is not modified)."""
-    step = min_step_size(sd, loader)
-    size = np.array([closest_larger_divisible(target_size[d], step[d])
-                     for d in range(3)], np.int64)
-    overlap = np.array([closest_larger_divisible(target_overlap[d], step[d])
-                        for d in range(3)], np.int64)
+    """Build a new virtually-split project (the input is not modified).
+
+    ``optimize`` rounds size/overlap up to the closest larger value divisible
+    by every stored downsampling step (Split_Views.closestLargerLongDivisableBy);
+    --disableOptimization uses the targets exactly."""
+    if optimize:
+        step = min_step_size(sd, loader)
+        size = np.array([closest_larger_divisible(target_size[d], step[d])
+                         for d in range(3)], np.int64)
+        overlap = np.array([closest_larger_divisible(target_overlap[d], step[d])
+                            for d in range(3)], np.int64)
+    else:
+        size = np.array(target_size, np.int64)
+        overlap = np.array(target_overlap, np.int64)
     if np.any(overlap > size):
         raise ValueError(f"overlap {overlap} cannot exceed size {size}")
 
@@ -149,12 +159,14 @@ def split_images(
         _plant_fake_points(
             sd, out, sub_of_source, fip_store,
             fip_density, fip_min, fip_max, fip_error, rng_seed,
+            exclusion_radius=fip_exclusion_radius,
         )
     return out
 
 
 def _plant_fake_points(
     sd, out, sub_of_source, store, density, fip_min, fip_max, error, seed,
+    exclusion_radius: float = 0.0,
 ) -> None:
     """Uniform random points in each overlap between sub-views of one source
     view, identical up to ``error`` jitter, with exact correspondences —
@@ -177,6 +189,16 @@ def _plant_fake_points(
                 n = int(np.clip(density * vol / 1e6, fip_min, fip_max))
                 p_src = rng.uniform(np.array(ov.min, float),
                                     np.array(ov.max, float) + 1.0, (n, 3))
+                if exclusion_radius > 0 and len(p_src) > 1:
+                    # greedy thinning: keep points at least the exclusion
+                    # radius apart (--fipExclusionRadius)
+                    kept: list[np.ndarray] = []
+                    for q in p_src:
+                        if all(np.linalg.norm(q - r) >= exclusion_radius
+                               for r in kept):
+                            kept.append(q)
+                    p_src = np.array(kept)
+                    n = len(p_src)
                 jit = rng.normal(0.0, error, (n, 3)) if error > 0 else 0.0
                 la = pts.setdefault(id_a, [])
                 lb = pts.setdefault(id_b, [])

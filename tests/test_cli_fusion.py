@@ -221,3 +221,91 @@ class TestMultiChannelTimepointFusion:
         empty = ds.read((0, 0, 0, 0, 0), (*ds.shape[:3], 1, 1))
         assert filled.std() > 0
         assert empty.std() == 0
+
+
+class TestCompressionLevel:
+    def test_cl_reaches_codec_metadata(self, tmp_path):
+        import json
+        import os
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(1, 1, 1), tile_size=(24, 24, 12),
+            overlap=8, n_beads_per_tile=3)
+        runner = CliRunner()
+        out = str(tmp_path / "c.n5")
+        r = runner.invoke(cli, [
+            "create-fusion-container", "-x", proj.xml_path, "-o", out,
+            "-s", "N5", "-d", "UINT16", "--blockSize", "16,16,8",
+            "-c", "gzip", "-cl", "9",
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        attrs = json.load(open(os.path.join(out, "ch0tp0", "s0", "attributes.json")))
+        assert attrs["compression"]["type"] == "gzip"
+        assert attrs["compression"]["level"] == 9
+
+    def test_zarr_level(self, tmp_path):
+        import json
+        import os
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(1, 1, 1), tile_size=(24, 24, 12),
+            overlap=8, n_beads_per_tile=3)
+        out = str(tmp_path / "c.ome.zarr")
+        r = CliRunner().invoke(cli, [
+            "create-fusion-container", "-x", proj.xml_path, "-o", out,
+            "-s", "ZARR", "-d", "UINT16", "--blockSize", "16,16,8",
+            "-c", "zstd", "-cl", "7",
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        meta = json.load(open(os.path.join(out, "0", ".zarray")))
+        assert meta["compressor"]["level"] == 7
+
+
+class TestNonrigidDirectOutput:
+    def test_direct_output_creates_container(self, tmp_path):
+        """SparkNonRigidFusion writes straight to an N5/ZARR (no
+        create-fusion-container step): -o <fresh> -x <xml> -p <dtype>."""
+        import numpy as np
+
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.interestpoints import InterestPointStore
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models.detection import (
+            DetectionParams, detect_interest_points, save_detections,
+        )
+        from bigstitcher_spark_tpu.models.matching import (
+            MatchingParams, match_interest_points, save_matches,
+        )
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(2, 1, 1), tile_size=(48, 48, 24),
+            overlap=24, jitter=2.0, seed=31, n_beads_per_tile=25)
+        sd = SpimData.load(proj.xml_path)
+        views = sorted(sd.registrations)
+        loader = ViewLoader(sd)
+        dets = detect_interest_points(
+            sd, loader, views,
+            DetectionParams(downsample_xy=1, downsample_z=1,
+                            block_size=(48, 48, 24)),
+            progress=False)
+        store = InterestPointStore.for_project(sd)
+        save_detections(sd, store, dets, DetectionParams())
+        mparams = MatchingParams(ransac_min_inliers=5,
+                                 ransac_iterations=2000,
+                                 model="TRANSLATION", regularization="NONE")
+        save_matches(sd, store,
+                     match_interest_points(sd, views, mparams, store,
+                                           progress=False),
+                     mparams, views)
+        sd.save()
+
+        out = str(tmp_path / "direct.ome.zarr")
+        r = CliRunner().invoke(cli, [
+            "nonrigid-fusion", "-o", out, "-x", proj.xml_path,
+            "-p", "FLOAT32", "-s", "ZARR", "-ip", "beads",
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        assert "direct output: created container" in r.output
+        ds = ChunkStore.open(out).open_dataset("0")
+        vol = np.asarray(ds.read((0, 0, 0, 0, 0), (*ds.shape[:3], 1, 1)))
+        assert vol.std() > 0

@@ -1,0 +1,127 @@
+"""CLI flag parity: every ACTIVE option spelling of the reference's picocli
+surface must be accepted by the corresponding tool here (extracted from the
+reference @Option declarations, commented-out options excluded — e.g.
+--firstTileWins and the Solver mapback options are disabled upstream).
+
+A reference user's scripts must run unchanged (drop-in goal, SURVEY.md §7).
+"""
+
+import pytest
+
+from bigstitcher_spark_tpu.cli.main import cli
+
+# tool -> active reference option spellings (source files under
+# /root/reference/src/main/java/net/preibisch/bigstitcher/spark/)
+REFERENCE_OPTIONS = {
+    # SparkAffineFusion.java
+    "affine-fusion": (
+        "-o --n5Path -s --storage --masks -f --fusion -t --timepointIndex "
+        "-c --channelIndex --angleId --tileId --illuminationId --channelId "
+        "--timepointId -vi --prefetch"
+    ),
+    # CreateFusionContainer.java
+    "create-fusion-container": (
+        "-o --outputPath -s --storage -c --compression -cl "
+        "--compressionLevel -ch --numChannels -tp --numTimepoints -d "
+        "--dataType --minIntensity --maxIntensity --bdv -xo --xmlout -b "
+        "--boundingBox --multiRes -ds --downsampling --preserveAnisotropy "
+        "--anisotropyFactor"
+    ),
+    # SparkResaveN5.java
+    "resave": "-xo --xmlout --N5 -ds --downsampling -c --compression -cl "
+              "--compressionLevel -o --n5Path",
+    # SparkInterestPointDetection.java
+    "detect-interestpoints": (
+        "-l --label -s --sigma -t --threshold --type --localization "
+        "--overlappingOnly --onlyCompareOverlapTiles --storeIntensities "
+        "-i0 --minIntensity -i1 --maxIntensity --prefetch --keepTemporaryN5 "
+        "--maxSpots --maxSpotsPerOverlap --medianFilter -dsxy --downsampleXY "
+        "-dsz --downsampleZ"
+    ),
+    # SparkGeometricDescriptorMatching.java
+    "match-interestpoints": (
+        "-l --label -m --method -s --significance -sr --searchRadius -r "
+        "--redundancy -n --numNeighbors --clearCorrespondences "
+        "--matchAcrossLabels -ipfr --interestpointsForReg -vr --viewReg "
+        "--interestPointMergeDistance --groupIllums --groupChannels "
+        "--groupTiles --splitTimepoints -rit --ransacIterations -rme "
+        "--ransacMaxError -rmir --ransacMinInlierRatio -rmni "
+        "--ransacMinNumInliers -rmc --ransacMultiConsensus -ime "
+        "--icpMaxError -iit --icpIterations --icpUseRANSAC"
+    ),
+    # SparkPairwiseStitching.java
+    "stitching": (
+        "-ds --downsampling -p --peaksToCheck --disableSubpixelResolution "
+        "--minR --maxR --maxShiftX --maxShiftY --maxShiftZ --maxShiftTotal "
+        "--channelCombine --illumCombine"
+    ),
+    # Solver.java (mapback options are commented out upstream)
+    "solver": (
+        "-s --sourcePoints --groupIllums --groupChannels --groupTiles "
+        "--splitTimepoints -l --label -lw --labelweights --method "
+        "--relativeThreshold --absoluteThreshold --maxError --maxIterations "
+        "--maxPlateauwidth --disableFixedViews -fv --fixedViews"
+    ),
+    # SparkNonRigidFusion.java
+    "nonrigid-fusion": (
+        "-o --n5Path -d --n5Dataset --bdv -xo --xmlout -s --storage -b "
+        "--boundingBox -ip --interestPoints -p --dataType --minIntensity "
+        "--maxIntensity"
+    ),
+    # SparkIntensityMatching.java
+    "match-intensities": (
+        "--numCoefficients --renderScale -o --outputPath --minThreshold "
+        "--maxThreshold --minNumCandidates --method --numIterations "
+        "--maxEpsilon --minInlierRatio --minNumInliers --maxTrust"
+    ),
+    # IntensitySolver.java
+    "solve-intensities": (
+        "--numCoefficients --matchesPath --maxIterations -o "
+        "--intensityN5Path -s --intensityN5Storage --intensityN5Group "
+        "--intensityN5Dataset"
+    ),
+    # SparkDownsample.java
+    "downsample": "-i --n5PathIn -di --n5DatasetIn -do --n5DatasetsOut "
+                  "-s --storage -ds --downsampling",
+    # SplitDatasets.java
+    "split-images": (
+        "-xo --xmlout -tis --targetImageSize -to --targetOverlap "
+        "--disableOptimization -fip --fakeInterestPoints --fipDensity "
+        "--fipMinNumPoints --fipMaxNumPoints --fipError "
+        "--fipExclusionRadius --assignIlluminations --displayResult"
+    ),
+    # TransformPoints.java
+    "transform-points": "-vi --csvIn -p --csvOut",
+    # ClearInterestPoints.java
+    "clear-interestpoints": "--correspondencesOnly",
+    # ClearRegistrations.java
+    "clear-registrations": "--keep --remove",
+}
+
+# shared infrastructure options (AbstractInfrastructure / AbstractBasic)
+SHARED = "--dryRun --s3Region"
+
+
+@pytest.mark.parametrize("tool", sorted(REFERENCE_OPTIONS))
+def test_reference_options_accepted(tool):
+    cmd = cli.commands[tool]
+    ours = set()
+    for p in cmd.params:
+        ours.update(p.opts)
+        ours.update(p.secondary_opts)
+    missing = [o for o in REFERENCE_OPTIONS[tool].split() if o not in ours]
+    assert not missing, f"{tool} missing reference options: {missing}"
+
+
+@pytest.mark.parametrize("tool", sorted(REFERENCE_OPTIONS))
+def test_shared_infrastructure_options(tool):
+    if tool in ("transform-points", "clear-registrations", "downsample",
+                "split-images", "inspect-interestpoints"):
+        pytest.skip("minimal per-reference surface")
+    cmd = cli.commands[tool]
+    ours = set()
+    for p in cmd.params:
+        ours.update(p.opts)
+        ours.update(p.secondary_opts)
+    missing = [o for o in SHARED.split() if o not in ours]
+    assert not missing, f"{tool} missing shared options: {missing}"

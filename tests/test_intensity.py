@@ -85,7 +85,13 @@ class TestIntensityPipeline:
         sd = SpimData.load(project.xml_path)
         loader = ViewLoader(sd)
         views = sorted(sd.registrations)
-        params = IntensityParams(coefficients=(2, 2, 2), render_scale=0.5)
+        # this test pins the match->solve equalization math, so the optional
+        # candidate filters are neutralized: min_threshold=0 keeps the
+        # fixture's informative dark samples, max_trust=inf disables the
+        # mpicbg-style trim (its behavior has its own test below)
+        params = IntensityParams(coefficients=(2, 2, 2), render_scale=0.5,
+                                 min_threshold=0.0,
+                                 max_trust=float("inf"))
         matches = match_intensities(sd, loader, views, params, progress=False)
         assert len(matches) > 0
         coeffs = solve_intensities(matches, views, params.coefficients,
@@ -152,3 +158,92 @@ class TestIntensityPipeline:
         jump_cor = abs(left["corrected"] - right["corrected"]) / right["corrected"]
         assert jump_raw > 0.15          # the miscalibration is visible
         assert jump_cor < jump_raw / 3  # correction removes most of it
+
+
+class TestCandidateFilters:
+    """The reference's matching filters (SparkIntensityMatching.java:51-77):
+    intensity thresholds, minNumCandidates, minNumInliers, maxTrust."""
+
+    def _pair_project(self, tmp_path, corrupt_fraction=0.0, seed=3):
+        """Two tiles whose shared content is a wide-dynamic-range ramp;
+        tile 1 stores 2*i + 10 (+ optional salt corruption). A ramp keeps
+        the per-cell line fit well-conditioned."""
+        import os
+
+        import numpy as np
+
+        from bigstitcher_spark_tpu.io.chunkstore import ChunkStore
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(2, 1, 1), tile_size=(48, 48, 24),
+            overlap=24, jitter=0.0, seed=seed, n_beads_per_tile=10)
+        store = ChunkStore.open(
+            os.path.join(os.path.dirname(proj.xml_path), "dataset.n5"))
+        rng = np.random.default_rng(seed)
+        # world-consistent ramp: value = 40*(world_x+y+z) sampled per tile
+        offsets = {0: 0.0, 1: 24.0}  # tile 1 starts at world x=24
+        ramp = {}
+        for s, x0 in offsets.items():
+            xs = np.arange(48) + x0
+            ramp[s] = (40.0 * (xs[:, None, None] + np.arange(48)[None, :, None]
+                               + np.arange(24)[None, None, :]))
+        ds0 = store.open_dataset("setup0/timepoint0/s0")
+        ds0.write(np.clip(ramp[0], 0, 65535).astype(np.uint16), (0, 0, 0))
+        ds1 = store.open_dataset("setup1/timepoint0/s0")
+        out = 2.0 * ramp[1] + 10
+        if corrupt_fraction:
+            mask = rng.random(out.shape) < corrupt_fraction
+            out[mask] = rng.uniform(0, 60000, int(mask.sum()))
+        ds1.write(np.clip(out, 0, 65535).astype(np.uint16), (0, 0, 0))
+        return proj
+
+    def test_max_threshold_discards_bright_samples(self, tmp_path):
+        import numpy as np
+
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models.intensity import (
+            IntensityParams, match_intensities,
+        )
+
+        proj = self._pair_project(tmp_path)
+        sd = SpimData.load(proj.xml_path)
+        loader = ViewLoader(sd)
+        views = sorted(sd.registrations)
+        base = IntensityParams(coefficients=(1, 1, 1), render_scale=1.0,
+                               min_threshold=0.0)
+        m_all = match_intensities(sd, loader, views, base, progress=False)
+        # a max threshold below the data range kills every candidate
+        cut = IntensityParams(coefficients=(1, 1, 1), render_scale=1.0,
+                              min_threshold=0.0, max_threshold=0.5)
+        m_cut = match_intensities(sd, loader, views, cut, progress=False)
+        assert len(m_all) > 0 and len(m_cut) == 0
+        # stats sample count respects minNumCandidates
+        many = IntensityParams(coefficients=(1, 1, 1), render_scale=1.0,
+                               min_threshold=0.0, min_num_candidates=10**9)
+        assert match_intensities(sd, loader, views, many,
+                                 progress=False) == []
+        n = m_all[0].stats[0]
+        assert n >= 10
+
+    def test_max_trust_resists_corruption(self, tmp_path):
+        """With 15% of view-1 pixels replaced by junk, the trust-trimmed fit
+        must stay close to the true line (2.0, 10)."""
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models.intensity import (
+            IntensityParams, match_intensities,
+        )
+
+        proj = self._pair_project(tmp_path, corrupt_fraction=0.15, seed=7)
+        sd = SpimData.load(proj.xml_path)
+        loader = ViewLoader(sd)
+        views = sorted(sd.registrations)
+        params = IntensityParams(coefficients=(1, 1, 1), render_scale=1.0,
+                                 min_threshold=0.0, max_trust=3.0)
+        ms = match_intensities(sd, loader, views, params, progress=False)
+        assert len(ms) == 1
+        a, b = ms[0].fit
+        assert abs(a - 2.0) < 0.1, (a, b)
+        assert abs(b - 10.0) < 60.0, (a, b)
