@@ -14,6 +14,7 @@ over the union of overlap boxes — same output set, no dedup needed.
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
+import functools
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -245,8 +246,21 @@ def _make_dog_kernel(n_dev: int, params: DetectionParams):
     coords + device-refined subpixel positions, ~KB/block across the host
     link instead of two dense volumes); with ``n_dev > 1`` the batch axis is
     sharded over the device mesh (one/few blocks per device)."""
-    k = int(params.max_candidates_per_block)
-    halo = dog_halo(params.sigma)
+    return _make_dog_kernel_cached(
+        n_dev, float(params.sigma), bool(params.find_max),
+        bool(params.find_min), int(params.max_candidates_per_block),
+        dog_halo(params.sigma))
+
+
+@functools.lru_cache(maxsize=32)
+def _make_dog_kernel_cached(n_dev, sigma, find_max, find_min, k, halo):
+    """lru_cache'd so repeated detections in one process (multi-run benches,
+    detection+nonrigid pipelines) reuse the sharded jit instead of
+    recompiling (same defect class as the nonrigid kernel, fixed r4)."""
+    from types import SimpleNamespace
+
+    params = SimpleNamespace(sigma=sigma, find_max=find_max,
+                             find_min=find_min)
     if n_dev <= 1:
         def kernel(blocks, lo, hi, thr, origins):
             with profiling.span("detection.kernel"):

@@ -63,9 +63,17 @@ def downsample_write_block(src: Dataset, dst: Dataset, block: GridBlock,
 
 def make_downsample_kernel(n_dev: int, rel):
     """Batched average-downsample kernel; batch axis sharded when n_dev > 1."""
-    import jax
+    return _make_downsample_kernel_cached(n_dev, tuple(int(f) for f in rel))
 
-    rel_t = tuple(int(f) for f in rel)
+
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _make_downsample_kernel_cached(n_dev: int, rel_t):
+    """lru_cache'd: pyramid writers call this once per level — without the
+    cache each level recompiled the same program."""
+    import jax
 
     def batched(raws):
         return jax.vmap(lambda x: downsample_block(x, rel_t))(raws)
