@@ -29,7 +29,7 @@ from ..io.spimdata import (
     registration_hash,
 )
 from ..ops.downsample import downsample_block
-from ..ops.phasecorr import pad_to, stitch_crops_batch
+from ..ops.phasecorr import pad_to, pcm_peaks_batch, refine_peaks
 from ..utils.geometry import (
     Interval,
     concatenate,
@@ -281,7 +281,8 @@ def _extract_pair_job(sd, loader, ga, gb, overlap, params) -> _PairJob | None:
 
 def _fft_shape(shape: Sequence[int]) -> tuple[int, ...]:
     """Next power of two per axis (TPU FFTs are fastest/most accurate at
-    powers of two; wrap ambiguity is resolved by the correlation check)."""
+    powers of two; wrap ambiguity is resolved by the host correlation
+    check, ops/phasecorr.refine_peaks)."""
     return tuple(1 << max(0, int(np.ceil(np.log2(max(int(s), 1))))) for s in shape)
 
 
@@ -328,18 +329,34 @@ def _stitch_one_bucket(sd, jobs: list[_PairJob], shp, params) -> list[PairwiseSt
     b = np.stack([pad_to(j.crop_b, shp) for j in jobs])
     ext_a = np.stack([np.array(j.crop_a.shape, np.int32) for j in jobs])
     ext_b = np.stack([np.array(j.crop_b.shape, np.int32) for j in jobs])
-    min_ov = np.array(
-        [max(params.min_overlap_px,
-             params.min_overlap_frac
-             * min(int(np.prod(j.crop_a.shape)), int(np.prod(j.crop_b.shape))))
-         for j in jobs], np.float32,
-    )
     with profiling.span("stitching.kernel"):
-        shifts, rs = stitch_crops_batch(
-            a, b, ext_a, ext_b, params.peaks_to_check, min_ov, params.subpixel,
-            0.25,
-        )
-        shifts, rs = np.asarray(shifts), np.asarray(rs)
+        peaks = np.asarray(pcm_peaks_batch(
+            a, b, ext_a, ext_b, params.peaks_to_check, 0.25))
+    # per-peak true-correlation scoring + subpixel on the overlap slices
+    # (host, float64 — see ops/phasecorr.refine_peaks); numpy reductions
+    # release the GIL, so pairs refine in parallel
+    shifts = np.zeros((len(jobs), 3))
+    rs = np.zeros(len(jobs))
+
+    def _refine(k):
+        j = jobs[k]
+        min_ov = max(
+            params.min_overlap_px,
+            params.min_overlap_frac
+            * min(int(np.prod(j.crop_a.shape)),
+                  int(np.prod(j.crop_b.shape))))
+        shifts[k], rs[k] = refine_peaks(
+            j.crop_a, j.crop_b, peaks[k], shp,
+            min_overlap=min_ov, subpixel=params.subpixel)
+
+    with profiling.span("stitching.refine"):
+        if len(jobs) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, len(jobs))) as pool:
+                list(pool.map(_refine, range(len(jobs))))
+        else:
+            _refine(0)
 
     ds = np.array(params.downsampling, np.float64)
     out = []

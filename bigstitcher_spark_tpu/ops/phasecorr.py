@@ -1,14 +1,20 @@
-"""FFT phase-correlation pairwise shift estimation (XLA).
+"""FFT phase-correlation pairwise shift estimation (XLA + host refinement).
 
 TPU-native re-design of the reference's stitching math (BigStitcher core
 ``PairwiseStitching``/``PhaseCorrelation2``, called at
-SparkPairwiseStitching.java:247-267): the two zero-padded overlap crops are
-phase-correlated with a 3-D FFT, the top-N local maxima of the correlation
-matrix are extracted, every peak's 2^3 periodic-wrap interpretations are
-scored by true (masked) Pearson cross-correlation, and the winner gets
-quadratic subpixel refinement. Everything is one fused, statically-shaped
-XLA computation per crop-shape bucket, vmappable over a batch of pairs —
-the reference runs one single-threaded Java FFT per Spark task instead.
+SparkPairwiseStitching.java:247-267), split by what each side is good at:
+
+- DEVICE (one fused, statically-shaped XLA computation per crop-shape
+  bucket, vmapped over the batch): windowing, 3-D FFT phase correlation,
+  3x3x3 local-maxima suppression, top-N peak extraction — the heavy regular
+  compute.
+- HOST (numpy, float64): scoring each peak's 2^3 periodic-wrap
+  interpretations by true Pearson correlation over the overlap SLICES, a
+  hill-climb to the best integer shift, quadratic subpixel refinement. These
+  touch only the (dynamic-shaped) overlap boxes — a few dozen tiny
+  reductions per pair that would each cost a full-volume masked pass under
+  static shapes (the r3 kernel did exactly that and spent 2 orders of
+  magnitude more HBM traffic there than on the FFTs).
 
 Shift convention: the returned ``shift`` s satisfies a[x] ~= b[x + s]; the
 correction to apply to view B's translation is ``-s`` (see
@@ -32,36 +38,6 @@ def _local_maxima(pcm: jnp.ndarray) -> jnp.ndarray:
     return pcm >= pooled
 
 
-def _masked_pearson(a, b_shifted, mask, min_overlap):
-    n = jnp.sum(mask)
-    am = jnp.sum(a * mask) / jnp.maximum(n, 1.0)
-    bm = jnp.sum(b_shifted * mask) / jnp.maximum(n, 1.0)
-    da = (a - am) * mask
-    db = (b_shifted - bm) * mask
-    cov = jnp.sum(da * db)
-    var = jnp.sqrt(jnp.sum(da * da) * jnp.sum(db * db))
-    r = jnp.where(var > 1e-12, cov / var, -1.0)
-    return jnp.where(n >= min_overlap, r, -jnp.inf), n
-
-
-def _corr_candidate(a, b, ext_a, ext_b, s, min_overlap):
-    """Pearson r of a[x] vs b[x+s] over the valid region (true
-    cross-correlation check of one candidate shift)."""
-    b_sh = b
-    for ax in range(3):
-        b_sh = jnp.roll(b_sh, -s[ax], axis=ax)
-    dims = a.shape
-    masks_1d = []
-    for ax in range(3):
-        x = jnp.arange(dims[ax])
-        lo = jnp.maximum(0, -s[ax])
-        hi = jnp.minimum(ext_a[ax], ext_b[ax] - s[ax])
-        masks_1d.append((x >= lo) & (x < hi))
-    mask = (masks_1d[0][:, None, None] & masks_1d[1][None, :, None]
-            & masks_1d[2][None, None, :]).astype(jnp.float32)
-    return _masked_pearson(a, b_sh, mask, min_overlap)
-
-
 def _windowed(img: jnp.ndarray, ext: jnp.ndarray, fade_frac: float):
     """Mean-subtract over the actual extent and apply a cosine (Hann-edge)
     fade so the crop-edge discontinuity does not dominate the PCM — without
@@ -83,25 +59,18 @@ def _windowed(img: jnp.ndarray, ext: jnp.ndarray, fade_frac: float):
     return (w - mean) * win
 
 
-@functools.partial(jax.jit, static_argnames=("n_peaks", "subpixel"))
-def stitch_crops(
+@functools.partial(jax.jit, static_argnames=("n_peaks",))
+def pcm_peaks(
     a: jnp.ndarray,           # (X,Y,Z) float32, zero-padded crop of group A
     b: jnp.ndarray,           # (X,Y,Z) float32, zero-padded crop of group B
     ext_a: jnp.ndarray,       # (3,) int32 actual extent of a before padding
     ext_b: jnp.ndarray,       # (3,) int32
     n_peaks: int = 5,
-    min_overlap: float = 32.0,
-    subpixel: bool = True,
     fade_frac: float = 0.25,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Estimate the shift between two crops. Returns (shift (3,) f32, r).
-
-    ``shift`` satisfies a[x] ~= b[x + shift]; r is the true cross-correlation
-    of the winning candidate (NOT the PCM value — reference checks peaks by
-    real correlation, SURVEY.md §2.2 'top-5 peak extraction, per-peak true
-    cross-correlation r'). The PCM is computed on windowed copies; the
-    correlation check uses the raw crops."""
-    shape = jnp.array(a.shape, jnp.int32)
+) -> jnp.ndarray:
+    """Top-N local maxima of the phase-correlation matrix -> (n_peaks, 3)
+    int32 wrapped indices. The PCM is computed on windowed copies; the
+    correlation check happens on the raw crops host-side."""
     fa = jnp.fft.rfftn(_windowed(a, ext_a, fade_frac))
     fb = jnp.fft.rfftn(_windowed(b, ext_b, fade_frac))
     cross = fa * jnp.conj(fb)
@@ -115,70 +84,119 @@ def stitch_crops(
     _, flat_idx = jax.lax.top_k(masked.ravel(), n_peaks)
     sy = a.shape[1] * a.shape[2]
     sz = a.shape[2]
-    peaks = jnp.stack(
+    return jnp.stack(
         [flat_idx // sy, (flat_idx // sz) % a.shape[1], flat_idx % a.shape[2]],
         axis=-1,
-    ).astype(jnp.int32)  # (n_peaks, 3)
+    ).astype(jnp.int32)
 
-    # all 2^3 periodic interpretations c in {p, p - N}; shift s = -c
-    combos = jnp.array(
-        [[(i >> d) & 1 for d in range(3)] for i in range(8)], jnp.int32
-    )  # (8, 3)
-    cands = peaks[:, None, :] - combos[None, :, :] * shape[None, None, :]
-    cands = cands.reshape(-1, 3)  # (n_peaks*8, 3)
-    shifts = -cands
 
-    def eval_cand(s):
-        r, n = _corr_candidate(a, b, ext_a, ext_b, s, min_overlap)
-        return r
+pcm_peaks_batch = jax.jit(
+    jax.vmap(pcm_peaks, in_axes=(0, 0, 0, 0, None, None)),
+    static_argnames=("n_peaks",),
+)
 
-    rs = jax.vmap(eval_cand)(shifts)
-    best = jnp.argmax(rs)
-    s0 = shifts[best]
-    r0 = rs[best]
+
+# ---------------------------------------------------------------------------
+# host-side refinement (float64, overlap slices only)
+# ---------------------------------------------------------------------------
+
+
+def _r_candidate(a, b, ext_a, ext_b, s, min_overlap) -> float:
+    """Pearson r of a[x] vs b[x+s] over the rectangular overlap (the
+    reference's per-peak true cross-correlation check)."""
+    lo = np.maximum(0, -s)
+    hi = np.minimum(ext_a, ext_b - s)
+    if np.any(hi - lo < 1) or float(np.prod(hi - lo)) < min_overlap:
+        return -np.inf
+    av = a[tuple(slice(int(lo[d]), int(hi[d])) for d in range(3))]
+    bv = b[tuple(slice(int(lo[d] + s[d]), int(hi[d] + s[d])) for d in range(3))]
+    am = av - av.mean(dtype=np.float64)
+    bm = bv - bv.mean(dtype=np.float64)
+    den = np.sqrt(np.sum(am * am, dtype=np.float64)
+                  * np.sum(bm * bm, dtype=np.float64))
+    if den <= 1e-12:
+        return -1.0
+    return float(np.sum(am * bm, dtype=np.float64) / den)
+
+
+def refine_peaks(
+    crop_a: np.ndarray,       # unpadded crop of group A (any float dtype)
+    crop_b: np.ndarray,
+    peaks: np.ndarray,        # (n_peaks, 3) wrapped PCM indices
+    fft_shape: tuple[int, int, int],
+    min_overlap: float = 32.0,
+    subpixel: bool = True,
+) -> tuple[np.ndarray, float]:
+    """Score peak wraps by true correlation, hill-climb (argmax over the 6
+    unit neighbors + self per round, 3 rounds — the round-1..3 device-kernel
+    search), then quadratic subpixel. Returns (shift (3,) f64, best r).
+    Candidate r values are memoized: the subpixel fit reuses the final
+    round's neighbor evaluations instead of recomputing them."""
+    a = np.asarray(crop_a, np.float64)
+    b = np.asarray(crop_b, np.float64)
+    ext_a = np.array(a.shape, np.int64)
+    ext_b = np.array(b.shape, np.int64)
+    N = np.array(fft_shape, np.int64)
+    memo: dict[tuple, float] = {}
+
+    def r_at(s):
+        key = tuple(int(v) for v in s)
+        if key not in memo:
+            memo[key] = _r_candidate(a, b, ext_a, ext_b, np.asarray(s), min_overlap)
+        return memo[key]
+
+    best_s, best_r = np.zeros(3, np.int64), -np.inf
+    for p in np.asarray(peaks, np.int64):
+        for wrap in range(8):
+            c = np.array([p[d] - (N[d] if (wrap >> d) & 1 else 0)
+                          for d in range(3)])
+            s = -c  # PCM index c names shift -c (see _windowed convention)
+            r = r_at(s)
+            if r > best_r:
+                best_r, best_s = r, s
+    if not np.isfinite(best_r):
+        return best_s.astype(np.float64), -1.0
 
     # hill-climb on the true correlation: the PCM peak can be split across
-    # voxels (windowing) so the best integer shift may be a neighbor of the
-    # best PCM candidate
-    unit = jnp.concatenate(
-        [jnp.zeros((1, 3), jnp.int32),
-         jnp.eye(3, dtype=jnp.int32), -jnp.eye(3, dtype=jnp.int32)], axis=0
-    )  # (7, 3)
+    # voxels (windowing) so the best integer shift may be a neighbor
+    unit = np.concatenate([np.zeros((1, 3), np.int64),
+                           np.eye(3, dtype=np.int64),
+                           -np.eye(3, dtype=np.int64)], axis=0)
+    for _ in range(3):
+        cand = best_s[None, :] + unit
+        rc = [r_at(s) for s in cand]
+        i = int(np.argmax(rc))
+        if i == 0:
+            break
+        best_s, best_r = cand[i], rc[i]
 
-    def climb(_, carry):
-        s, r = carry
-        cand = s[None, :] + unit
-        rc = jax.vmap(eval_cand)(cand)
-        i = jnp.argmax(rc)
-        return cand[i], rc[i]
-
-    s_int, best_r = jax.lax.fori_loop(0, 3, climb, (s0, r0))
-    best_shift = s_int.astype(jnp.float32)
-
+    shift = best_s.astype(np.float64)
     if subpixel:
-        # quadratic fit per axis on the correlation values at s +- 1
-        neigh = jnp.concatenate(
-            [jnp.eye(3, dtype=jnp.int32), -jnp.eye(3, dtype=jnp.int32)], axis=0
-        )
-        rn = jax.vmap(eval_cand)(s_int[None, :] + neigh)  # (6,) [+x,+y,+z,-x,-y,-z]
-        offs = []
         for ax in range(3):
-            fp, fm = rn[ax], rn[ax + 3]
+            e = np.zeros(3, np.int64)
+            e[ax] = 1
+            fp, fm = r_at(best_s + e), r_at(best_s - e)
             denom = fm - 2.0 * best_r + fp
-            off = jnp.where((jnp.abs(denom) > 1e-12) & jnp.isfinite(fp)
-                            & jnp.isfinite(fm),
-                            0.5 * (fm - fp) / denom, 0.0)
-            offs.append(jnp.clip(off, -0.5, 0.5))
-        best_shift = best_shift + jnp.stack(offs)
-    return best_shift, best_r
+            if abs(denom) > 1e-12 and np.isfinite(fp) and np.isfinite(fm):
+                shift[ax] += float(np.clip(0.5 * (fm - fp) / denom, -0.5, 0.5))
+    return shift, float(best_r)
 
 
-# min_overlap is batched (axis 5): each pair keeps its own 10%-of-crop
-# threshold regardless of which pairs share its batch
-stitch_crops_batch = jax.jit(
-    jax.vmap(stitch_crops, in_axes=(0, 0, 0, 0, None, 0, None, None)),
-    static_argnames=("n_peaks", "subpixel"),
-)
+def stitch_crops(
+    a, b, ext_a, ext_b, n_peaks: int = 5, min_overlap: float = 32.0,
+    subpixel: bool = True, fade_frac: float = 0.25,
+) -> tuple[np.ndarray, float]:
+    """Single-pair convenience: device PCM peaks + host refinement.
+    ``a``/``b`` are padded crops; ``ext_*`` their true extents."""
+    peaks = np.asarray(pcm_peaks(jnp.asarray(a), jnp.asarray(b),
+                                 jnp.asarray(ext_a), jnp.asarray(ext_b),
+                                 n_peaks, fade_frac))
+    ea = tuple(int(v) for v in np.asarray(ext_a))
+    eb = tuple(int(v) for v in np.asarray(ext_b))
+    crop_a = np.asarray(a)[tuple(slice(0, s) for s in ea)]
+    crop_b = np.asarray(b)[tuple(slice(0, s) for s in eb)]
+    return refine_peaks(crop_a, crop_b, peaks, tuple(np.asarray(a).shape),
+                        min_overlap=min_overlap, subpixel=subpixel)
 
 
 def pad_to(crop: np.ndarray, shape: tuple[int, ...]) -> np.ndarray:
