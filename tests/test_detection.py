@@ -286,3 +286,28 @@ def test_topk_truncation_warns_and_keeps_strongest(tmp_path):
     # within near-ties)
     cutoff = np.sort(np.abs(full[0].values))[-(2 * k):][0]
     assert (np.abs(trunc[0].values) >= cutoff * 0.98).all()
+
+
+def test_blur_strategies_agree_on_core():
+    """The FFT transfer-function DoG (CPU default) and the Toeplitz-GEMM
+    blur chain (TPU default) must agree on the halo core to float rounding —
+    they apply the same truncated discrete kernels with different edge
+    topologies (circular vs reflect), which only differ inside the halo."""
+    import numpy as np
+
+    from bigstitcher_spark_tpu.ops.dog import (
+        DOG_K, _blur_separable, _dog_response_fft, dog_halo,
+        gaussian_kernel_1d,
+    )
+
+    rng = np.random.default_rng(4)
+    x = rng.random((48, 40, 32)).astype(np.float32)
+    s1 = 1.8
+    k1 = gaussian_kernel_1d(s1)
+    k2 = gaussian_kernel_1d(s1 * DOG_K)
+    gemm = np.asarray(_blur_separable(x, [k1] * 3)
+                      - _blur_separable(x, [k2] * 3))
+    fft = np.asarray(_dog_response_fft(x, k1, k2))
+    h = dog_halo(s1)
+    core = (slice(h, -h),) * 3
+    np.testing.assert_allclose(fft[core], gemm[core], atol=2e-6)
