@@ -154,9 +154,10 @@ class Dataset:
     def write(self, data: np.ndarray, offset: Sequence[int]) -> None:
         """Write a numpy array (xyz-first) at an xyz-first offset.
 
-        Block-aligned N5 writes take the native codec fast path (GIL-free
-        zstd encode + file write, io.native_blockio) when available."""
-        if self._native_write(data, offset):
+        Block-aligned N5 and zarr writes take the native codec fast path
+        (GIL-free strided copy + zstd encode + file write,
+        io.native_blockio) when available."""
+        if self._native_write(data, offset) or self._native_write_zarr(data, offset):
             return
         sel = self._sel(offset, data.shape)
         if self.reversed_axes:
@@ -211,6 +212,71 @@ class Dataset:
                             *[str(p) for p in pos])
         level = int(comp.get("level", 3)) or 3
         native_blockio.write_block(path, data, compression=ctype, level=level)
+        return True
+
+    def _zarr_meta(self) -> dict | None:
+        if not hasattr(self, "_zarr_meta_cache"):
+            try:
+                with open(os.path.join(self.store._kvpath(self.path),
+                                       ".zarray")) as f:
+                    self._zarr_meta_cache = json.load(f)
+            except (OSError, ValueError):
+                self._zarr_meta_cache = None
+        return self._zarr_meta_cache
+
+    def _native_write_zarr(self, data: np.ndarray, offset: Sequence[int]) -> bool:
+        """zarr v2 + zstd/raw + chunk-aligned box -> write chunk files
+        natively: the C side walks the transposed (disk-order) strides, so no
+        Python-side transpose copy happens. Returns False when ineligible."""
+        if (not self.reversed_axes or self.store is None
+                or getattr(self.store, "format", None) != StorageFormat.ZARR
+                or not getattr(self.store, "is_local", False)
+                or os.environ.get("BST_NATIVE_IO", "1") != "1"):
+            return False
+        from . import native_blockio
+
+        if not native_blockio.has_zarr():
+            return False
+        meta = self._zarr_meta()
+        if (meta is None or meta.get("order") != "C"
+                or meta.get("dimension_separator", ".") != "."
+                or meta.get("filters")):
+            return False
+        comp = meta.get("compressor")
+        if comp is None:
+            ctype, level = "raw", 0
+        elif comp.get("id") == "zstd":
+            ctype, level = "zstd", int(comp.get("level", 3))
+        else:
+            return False
+        if data.dtype != self.dtype or np.dtype(meta["dtype"]).byteorder == ">":
+            return False
+        fill = meta.get("fill_value") or 0
+        block = self.block_size
+        dims = self.shape
+        for d in range(data.ndim):
+            o, s = int(offset[d]), int(data.shape[d])
+            if o % block[d] != 0 or s <= 0:
+                return False
+            if (o + s) != dims[d] and (o + s) % block[d] != 0:
+                return False  # box must end on a chunk (or array) boundary
+        import itertools
+
+        root = self.store._kvpath(self.path)
+        grid = [range(0, int(data.shape[d]), block[d])
+                for d in range(data.ndim)]
+        for corner in itertools.product(*grid):
+            sub = data[tuple(slice(c, min(c + block[d], data.shape[d]))
+                             for d, c in enumerate(corner))]
+            pos = [(int(offset[d]) + c) // block[d]
+                   for d, c in enumerate(corner)]
+            name = ".".join(str(p) for p in reversed(pos))
+            rev = tuple(range(sub.ndim))[::-1]
+            native_blockio.write_zarr_chunk(
+                os.path.join(root, name), sub.transpose(rev),
+                tuple(reversed(block)), compression=ctype, level=level,
+                fill_value=fill,
+            )
         return True
 
     def read_full(self) -> np.ndarray:

@@ -57,8 +57,52 @@ def _load():
         ctypes.c_int64, ctypes.POINTER(ctypes.c_uint32),
         ctypes.POINTER(ctypes.c_int32),
     ]
+    if hasattr(lib, "zarr_write_chunk_file"):
+        lib.zarr_write_chunk_file.restype = ctypes.c_int64
+        lib.zarr_write_chunk_file.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_int32,
+        ]
     _LIB = lib
     return _LIB
+
+
+def has_zarr() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "zarr_write_chunk_file")
+
+
+def write_zarr_chunk(
+    chunk_path: str,
+    data: np.ndarray,
+    chunk_shape: tuple[int, ...],
+    compression: str = "zstd",
+    level: int = 3,
+    fill_value=0,
+) -> None:
+    """Write one zarr v2 chunk file from a strided DISK-ORDER view.
+
+    ``data``'s axes must already be in on-disk (C) order — callers pass a
+    transposed numpy VIEW (no copy; the C side walks the strides). Chunks
+    shorter than ``chunk_shape`` (array edge) are padded with
+    ``fill_value``."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "zarr_write_chunk_file"):
+        raise RuntimeError("native zarr chunk writer not available")
+    ndim = data.ndim
+    strides = (ctypes.c_int64 * ndim)(*data.strides)
+    src_dims = (ctypes.c_uint32 * ndim)(*data.shape)
+    chk_dims = (ctypes.c_uint32 * ndim)(*chunk_shape)
+    fill = np.asarray(fill_value or 0, dtype=data.dtype).tobytes()
+    got = lib.zarr_write_chunk_file(
+        chunk_path.encode(), data.ctypes.data_as(ctypes.c_void_p),
+        data.dtype.itemsize, strides, src_dims, chk_dims, ndim,
+        ctypes.c_char_p(fill), COMPRESSION[compression], level,
+    )
+    if got < 0:
+        raise IOError(f"zarr_write_chunk_file({chunk_path}) failed: {got}")
 
 
 def available() -> bool:

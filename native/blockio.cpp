@@ -189,6 +189,89 @@ int64_t n5_write_block_file(const char* path, const uint8_t* data,
   return wrote == static_cast<size_t>(enc) ? enc : -6;
 }
 
+// Encode + write one zarr (v2) chunk file. Zarr chunks are always FULL
+// chunk_dims in C order with fill beyond the array edge; the source region
+// is a strided view (strides in BYTES, same dim order as chunk_dims), so a
+// logically-transposed numpy view writes without a Python-side copy.
+// fill is the byte pattern for padding (elem_size bytes, normally zeros).
+// compression: 0 = raw, 1 = zstd(level). Returns bytes written or <0.
+int64_t zarr_write_chunk_file(const char* path, const uint8_t* data,
+                              int32_t elem_size, const int64_t* strides,
+                              const uint32_t* src_dims,
+                              const uint32_t* chunk_dims, int32_t ndim,
+                              const uint8_t* fill, int32_t compression,
+                              int32_t level) {
+  if (ndim <= 0 || ndim > 8) return -1;
+  int64_t n_chunk = 1;
+  for (int32_t d = 0; d < ndim; ++d) n_chunk *= chunk_dims[d];
+  const size_t raw = static_cast<size_t>(n_chunk) * elem_size;
+  std::string buf;
+  buf.resize(raw);
+  uint8_t* out = reinterpret_cast<uint8_t*>(&buf[0]);
+  bool zero_fill = true;
+  for (int32_t b = 0; b < elem_size; ++b) zero_fill &= (fill[b] == 0);
+  bool full = true;
+  for (int32_t d = 0; d < ndim; ++d) full &= (src_dims[d] == chunk_dims[d]);
+  if (!full) {
+    if (zero_fill) {
+      std::memset(out, 0, raw);
+    } else {
+      for (int64_t i = 0; i < n_chunk; ++i)
+        std::memcpy(out + i * elem_size, fill, elem_size);
+    }
+  }
+  // odometer over all but the innermost dim; memcpy contiguous inner runs
+  // when the innermost stride is dense, else element-wise
+  int64_t chunk_stride[8];
+  chunk_stride[ndim - 1] = elem_size;
+  for (int32_t d = ndim - 2; d >= 0; --d)
+    chunk_stride[d] = chunk_stride[d + 1] * chunk_dims[d + 1];
+  const bool dense_inner = strides[ndim - 1] == elem_size;
+  uint32_t idx[8] = {0};
+  const int64_t inner = src_dims[ndim - 1];
+  for (;;) {
+    int64_t src_off = 0, dst_off = 0;
+    for (int32_t d = 0; d < ndim - 1; ++d) {
+      src_off += static_cast<int64_t>(idx[d]) * strides[d];
+      dst_off += static_cast<int64_t>(idx[d]) * chunk_stride[d];
+    }
+    if (dense_inner) {
+      std::memcpy(out + dst_off, data + src_off,
+                  static_cast<size_t>(inner) * elem_size);
+    } else {
+      for (int64_t i = 0; i < inner; ++i)
+        std::memcpy(out + dst_off + i * elem_size,
+                    data + src_off + i * strides[ndim - 1], elem_size);
+    }
+    int32_t d = ndim - 2;
+    for (; d >= 0; --d) {
+      if (++idx[d] < src_dims[d]) break;
+      idx[d] = 0;
+    }
+    if (d < 0) break;
+  }
+  std::string p(path);
+  if (!mkdirs_for(p)) return -4;
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -5;
+  int64_t wrote;
+  if (compression == 0) {
+    wrote = static_cast<int64_t>(std::fwrite(buf.data(), 1, raw, f));
+    std::fclose(f);
+    return wrote == static_cast<int64_t>(raw) ? wrote : -6;
+  }
+  std::string enc;
+  enc.resize(ZSTD_compressBound(raw));
+  const size_t got = ZSTD_compress(&enc[0], enc.size(), buf.data(), raw, level);
+  if (ZSTD_isError(got)) {
+    std::fclose(f);
+    return -2;
+  }
+  wrote = static_cast<int64_t>(std::fwrite(enc.data(), 1, got, f));
+  std::fclose(f);
+  return wrote == static_cast<int64_t>(got) ? wrote : -6;
+}
+
 // Read + decode one block file. Returns elements decoded, <0 on error
 // (-7: file missing).
 int64_t n5_read_block_file(const char* path, int32_t elem_size,
