@@ -74,6 +74,15 @@ class _ViewPlan:
         fast path applies (ops.fusion.fuse_block_shift)."""
         return bool(np.allclose(self.inv_total[:, :3], np.eye(3), atol=1e-7))
 
+    @property
+    def is_diagonal(self) -> bool:
+        """True when the linear part is axis-aligned (diagonal) — e.g.
+        translation-registered tiles under --preserveAnisotropy z-scaling:
+        sampling factorizes into three 1-D interpolation GEMMs, no gathers
+        (ops.fusion.fuse_block_sep)."""
+        lin = self.inv_total[:, :3]
+        return bool(np.allclose(lin, np.diag(np.diagonal(lin)), atol=1e-7))
+
 
 def plan_block(
     sd: SpimData,
@@ -154,6 +163,12 @@ def fuse_grid_block(
         return _fuse_shift_path(
             loader, plans, block, block_global, bshape, fusion_type, blend,
             stats, inside_offset,
+        )
+
+    if coefficients is None and all(p.is_diagonal for p in plans):
+        return _fuse_sep_path(
+            sd, loader, plans, block, bshape, fusion_type, blend, stats,
+            inside_offset, patch_quantum,
         )
 
     vb = F.bucket_views(len(plans))
@@ -265,6 +280,41 @@ def _shift_inputs(loader, plans, block_global, bshape, vb, blend,
         valid[i] = 1.0
     ioffs = np.tile(np.asarray(inside_offset, np.float32), (vb, 1))
     return patches, fracs, lpos0, img_dims, borders, ranges, valid, ioffs
+
+
+def _sep_inputs(sd, loader, plans, pshape, vb, blend, inside_offset):
+    """Host-side staging for the diagonal separable kernel: same clipped
+    patch prefetch as the gather path, plus the per-view (diag, t) of the
+    block-index -> patch-coordinate affine."""
+    (patches, affines, offsets, img_dims, borders, ranges, valid, ioffs,
+     _c, _ca) = _gather_inputs(sd, loader, plans, pshape, vb, blend,
+                               inside_offset, None)
+    diags = np.ascontiguousarray(
+        np.stack([np.diagonal(affines[i, :, :3]) for i in range(vb)]))
+    ts = np.ascontiguousarray(affines[:, :, 3])
+    return patches, diags, ts, offsets, img_dims, borders, ranges, valid, ioffs
+
+
+def _fuse_sep_path(sd, loader, plans, block, bshape, fusion_type, blend,
+                   stats, inside_offset=(0.0, 0.0, 0.0), patch_quantum=32):
+    """Diagonal-affine blocks (e.g. --preserveAnisotropy over
+    translation-registered views): separable interpolation GEMMs, no
+    gathers."""
+    vb = F.bucket_views(len(plans))
+    pshape = F.bucket_shape(
+        np.max([p.patch_interval.shape for p in plans], axis=0), patch_quantum)
+    (patches, diags, ts, offsets, img_dims, borders, ranges, valid, ioffs
+     ) = _sep_inputs(sd, loader, plans, pshape, vb, blend, inside_offset)
+    if stats is not None:
+        stats.compile_keys.add((bshape, pshape, "sep", vb, fusion_type))
+    with profiling.span("fusion.kernel"):
+        fused, wsum = F.fuse_block_sep(
+            patches, diags, ts, offsets, img_dims, borders, ranges, valid,
+            block_shape=bshape, fusion_type=fusion_type, inside_offs=ioffs,
+        )
+        fused, wsum = np.asarray(fused), np.asarray(wsum)
+    sl = tuple(slice(0, s) for s in block.size)
+    return fused[sl], wsum[sl]
 
 
 def _fuse_shift_path(loader, plans, block, block_global, bshape, fusion_type,
@@ -527,7 +577,10 @@ def _fuse_volume_sharded(
             pshape = F.bucket_shape(
                 np.max([p.patch_interval.shape for p in plans], axis=0),
                 patch_quantum)
-            key = ("gather", pshape, vb)
+            if coefficients is None and all(p.is_diagonal for p in plans):
+                key = ("sep", pshape, vb)
+            else:
+                key = ("gather", pshape, vb)
         buckets.setdefault(key, []).append(item)
 
     mesh = make_mesh(n_dev)
@@ -550,6 +603,9 @@ def _fuse_volume_sharded(
                 if _kernel == "shift":
                     arrs = _shift_inputs(loader, plans, bg, compute_block,
                                          _vb, blend, inside_offset)
+                elif _kernel == "sep":
+                    arrs = _sep_inputs(sd, loader, plans, _key[1], _vb,
+                                       blend, inside_offset)
                 else:
                     arrs = _gather_inputs(sd, loader, plans, _key[1], _vb,
                                           blend, inside_offset, coefficients)

@@ -206,6 +206,74 @@ def _one_view_shift(patch, frac, lpos0, img_dim, border, blend_range,
     return val, inside, blend
 
 
+def _axis_blend_at(pos, dim, border, blend_range, inside_off=0.0):
+    """1-D blend weight + inside mask at arbitrary float positions (the
+    non-unit-step generalization of ``_axis_blend``)."""
+    lo = border
+    hi = dim - 1.0 - border
+    d = jnp.minimum(pos - lo, hi - pos)
+    r = jnp.maximum(blend_range, 1e-6)
+    ramp = 0.5 * (jnp.cos((1.0 - d / r) * jnp.pi) + 1.0)
+    w = jnp.where(d < 0, 0.0, jnp.where(d < r, ramp, 1.0))
+    inside = ((pos >= -inside_off) & (pos <= dim - 1.0 + inside_off)).astype(
+        jnp.float32)
+    return w, inside
+
+
+def _one_view_sep(patch, diag, t, patch_offset, img_dim, border, blend_range,
+                  inside_off, block_shape):
+    """One view with a DIAGONAL block->patch affine (axis-aligned scale +
+    translation — e.g. translation-registered tiles under --preserveAnisotropy
+    z-scaling): trilinear sampling factorizes into three 1-D interpolation
+    matrix contractions (GEMMs), no gathers; blending stays separable."""
+    L = block_shape
+    so = patch
+    ws, ins = [], []
+    for d in range(3):
+        pos = diag[d] * jnp.arange(L[d], dtype=jnp.float32) + t[d]
+        m = _separable_interp_matrix(pos, patch.shape[d])
+        so = jnp.tensordot(so, m, axes=[[0], [1]])
+        lpos = pos + patch_offset[d]
+        w, i = _axis_blend_at(lpos, img_dim[d], border[d], blend_range[d],
+                              inside_off[d])
+        ws.append(w)
+        ins.append(i)
+    blend = ws[0][:, None, None] * ws[1][None, :, None] * ws[2][None, None, :]
+    inside = ins[0][:, None, None] * ins[1][None, :, None] * ins[2][None, None, :]
+    return so, inside, blend
+
+
+def fuse_block_sep_impl(
+    patches: jnp.ndarray,       # (V, Px, Py, Pz) float32
+    diags: jnp.ndarray,         # (V, 3) diagonal of the block->patch affine
+    ts: jnp.ndarray,            # (V, 3) its translation
+    patch_offsets: jnp.ndarray,  # (V, 3) patch origin in level coords
+    img_dims: jnp.ndarray,      # (V, 3)
+    borders: jnp.ndarray,       # (V, 3)
+    blend_ranges: jnp.ndarray,  # (V, 3)
+    valid: jnp.ndarray,         # (V,)
+    block_shape: tuple[int, int, int],
+    fusion_type: str = "AVG_BLEND",
+    inside_offs: jnp.ndarray | None = None,
+):
+    if inside_offs is None:
+        inside_offs = jnp.zeros_like(borders)
+
+    def one(*args):
+        return _one_view_sep(*args, block_shape=block_shape)
+
+    vals, insides, wblends = jax.vmap(
+        one, in_axes=(0, 0, 0, 0, 0, 0, 0, 0),
+    )(patches, diags, ts, patch_offsets, img_dims, borders, blend_ranges,
+      inside_offs)
+    return _combine_views(vals, insides, wblends, valid, fusion_type)
+
+
+fuse_block_sep = jax.jit(
+    fuse_block_sep_impl, static_argnames=("block_shape", "fusion_type")
+)
+
+
 def _combine_views(vals, insides, wblends, valid, fusion_type: str):
     """Combine per-view samples (V, ...) by fusion type -> (fused, wsum)."""
     extra = (1,) * (vals.ndim - 1)

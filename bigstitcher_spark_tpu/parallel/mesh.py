@@ -77,6 +77,14 @@ def make_sharded_fuser(
             )
 
         n_in = 10 if with_coeffs else 8
+    elif kernel == "sep":
+        def core(p, dg, t, o, d, b, r, v, io):
+            return F.fuse_block_sep_impl(
+                p, dg, t, o, d, b, r, v, block_shape=block_shape,
+                fusion_type=fusion_type, inside_offs=io,
+            )
+
+        n_in = 9
     elif kernel == "shift":
         def core(p, f, l, d, b, r, v, io):  # noqa: E741
             return F.fuse_block_shift_impl(
