@@ -360,6 +360,9 @@ class CompositePlan:
     inside_offs: np.ndarray
     coeffs: np.ndarray | None = None       # (V, Cx,Cy,Cz, 2) intensity maps
     coeff_affs: np.ndarray | None = None   # (V, 3, 4) diagonal lpos->grid
+    kinds: tuple = ()                      # per-view "shift" | "sep"
+    diags: np.ndarray | None = None        # (V, 3) sampling step per axis
+    offs: np.ndarray | None = None         # (V, 3) tile coord of output idx 0
 
 
 def plan_composite_volume(
@@ -372,8 +375,14 @@ def plan_composite_volume(
     plans = plan_block(sd, loader, views, vol_iv, anisotropy)
     if not plans:
         return None
-    if any(not p.is_translation or p.level != 0 for p in plans):
+    if any(not (p.is_translation or p.is_diagonal) or p.level != 0
+           for p in plans):
         return None
+    if coefficients is not None and any(not p.is_translation for p in plans):
+        return None  # coeffs + diagonal views -> per-block path
+    if any(not p.is_translation
+           and np.any(np.diagonal(p.inv_total[:, :3]) <= 0) for p in plans):
+        return None  # mirrored axes: keep the general gather path
     shapes = [tuple(int(s) for s in p.img_dim) for p in plans]
     itemsizes = [np.dtype(loader.open(p.view, 0).dtype).itemsize
                  for p in plans]
@@ -391,8 +400,10 @@ def plan_composite_volume(
     # tile pad must cover the window widening from --maskOffset inside-test
     # expansion, or the static corner slices run out of bounds
     pad = tuple(1 + io_ceil[d] for d in range(3))
-    windows, n_offs = [], []
+    windows, n_offs, kinds = [], [], []
     fracs = np.zeros((len(plans), 3), np.float32)
+    diags = np.ones((len(plans), 3), np.float32)
+    offs = np.zeros((len(plans), 3), np.float32)
     img_dims = np.ones((len(plans), 3), np.float32)
     borders = np.zeros((len(plans), 3), np.float32)
     ranges = np.ones((len(plans), 3), np.float32)
@@ -403,15 +414,30 @@ def plan_composite_volume(
     for i, p in enumerate(plans):
         # tile coord of output voxel (0,0,0): g = inv_total @ bbox.min
         g = p.inv_total[:, :3] @ bb_min + p.inv_total[:, 3]
-        n = np.floor(g).astype(np.int64)
-        f = g - n
         S = shapes[i]
-        a = tuple(int(max(0, -n[d] - 1 - io_ceil[d])) for d in range(3))
-        b = tuple(int(min(out_shape[d], S[d] - n[d] + io_ceil[d]))
-                  for d in range(3))
+        if p.is_translation:
+            kinds.append("shift")
+            n = np.floor(g).astype(np.int64)
+            f = g - n
+            a = tuple(int(max(0, -n[d] - 1 - io_ceil[d])) for d in range(3))
+            b = tuple(int(min(out_shape[d], S[d] - n[d] + io_ceil[d]))
+                      for d in range(3))
+            n_offs.append(tuple(int(v) for v in n))
+            fracs[i] = f
+        else:
+            # diagonal: tile coord at output idx = diag*idx + g; window from
+            # the inverse map of the tile extent [-1, S] (+maskOffset slack)
+            kinds.append("sep")
+            dg = np.diagonal(p.inv_total[:, :3]).astype(np.float64)
+            a = tuple(int(max(0, np.floor((-1.0 - io_ceil[d] - g[d]) / dg[d])))
+                      for d in range(3))
+            b = tuple(int(min(out_shape[d],
+                              np.ceil((S[d] + io_ceil[d] - g[d]) / dg[d]) + 1))
+                      for d in range(3))
+            n_offs.append((0, 0, 0))
+            diags[i] = dg
+            offs[i] = g
         windows.append((a, b))
-        n_offs.append(tuple(int(v) for v in n))
-        fracs[i] = f
         img_dims[i] = p.img_dim
         factors = loader.downsampling_factors(p.view.setup)[p.level]
         borders[i] = np.asarray(blend.border) / np.asarray(factors)
@@ -422,7 +448,7 @@ def plan_composite_volume(
             sd, loader, plans, coefficients, len(plans))
     return CompositePlan(plans, out_shape, tuple(windows), tuple(n_offs),
                          pad, fracs, img_dims, borders, ranges, inside_offs,
-                         coeffs, coeff_affs)
+                         coeffs, coeff_affs, tuple(kinds), diags, offs)
 
 
 def upload_composite_tiles(loader, cp: CompositePlan) -> list:
@@ -442,11 +468,11 @@ def dispatch_composite(cp: CompositePlan, tiles, fusion_type, out_dtype,
     fuser = F.make_translation_composite(
         cp.out_shape, cp.windows, cp.n_offs, pad=cp.pad,
         fusion_type=fusion_type, out_dtype=out_dtype, masks=masks,
-        with_coeffs=with_coeffs)
+        with_coeffs=with_coeffs, kinds=cp.kinds)
     extra = (cp.coeffs, cp.coeff_affs) if with_coeffs else ()
     return fuser(tiles, cp.fracs, cp.img_dims, cp.borders, cp.ranges,
                  cp.inside_offs, np.float32(min_intensity),
-                 np.float32(max_intensity), *extra)
+                 np.float32(max_intensity), cp.diags, cp.offs, *extra)
 
 
 def _try_fuse_volume_device(

@@ -479,6 +479,28 @@ def _composite_one_view(P, frac, img_dim, border, blend_range, inside_off,
     return val, inside, blend
 
 
+def _composite_one_view_sep(P, diag, off, img_dim, border, blend_range,
+                            inside_off, a, L, pad):
+    """Diagonal-affine sibling of ``_composite_one_view``: sampling positions
+    step by ``diag`` per output voxel, so the tile contribution is three 1-D
+    interpolation matrix contractions (GEMMs) over the padded tile — no
+    gathers, still a static window."""
+    so = P
+    ws, ins = [], []
+    for d in range(3):
+        pos = (diag[d] * (a[d] + jnp.arange(L[d], dtype=jnp.float32))
+               + off[d])
+        m = _separable_interp_matrix(pos + pad[d], P.shape[d])
+        so = jnp.tensordot(so, m, axes=[[0], [1]])
+        w, i = _axis_blend_at(pos, img_dim[d], border[d], blend_range[d],
+                              inside_off[d])
+        ws.append(w)
+        ins.append(i)
+    blend = ws[0][:, None, None] * ws[1][None, :, None] * ws[2][None, None, :]
+    inside = ins[0][:, None, None] * ins[1][None, :, None] * ins[2][None, None, :]
+    return so, inside, blend
+
+
 def _separable_interp_matrix(pos, c: int):
     """(L, c) linear-interpolation matrix for 1-D grid coords ``pos`` (L,),
     edge-clamped: row i holds weights (1-f) at floor(pos_i), f at floor+1.
@@ -503,20 +525,32 @@ def make_translation_composite(
     out_dtype: str = "float32",
     masks: bool = False,
     with_coeffs: bool = False,
+    kinds: tuple = (),   # per-view "shift" | "sep" ("" -> all shift)
 ):
     """Build + jit the composite fusion program for one volume layout.
 
     Returned fn(tiles, fracs, img_dims, borders, ranges, inside_offs,
-    min_i, max_i[, coeffs, coeff_affs]) -> converted output of
-    ``out_shape``. ``tiles`` is a list of raw (unpadded) per-view tiles (any
-    integer/float dtype). With ``with_coeffs``, per-view (Cx,Cy,Cz,2)
-    intensity grids [scale, offset] are applied inside the kernel —
-    trilinear over the window via separable interpolation matrices
+    min_i, max_i[, diags, offs][, coeffs, coeff_affs]) -> converted output
+    of ``out_shape``. ``tiles`` is a list of raw (unpadded) per-view tiles
+    (any integer/float dtype). Views may mix two sampling kinds: "shift"
+    (translation: 8 statically-shifted slices) and "sep" (diagonal affine:
+    separable interpolation GEMMs) — ``diags``/``offs`` are consumed by the
+    "sep" views. With ``with_coeffs``, per-view (Cx,Cy,Cz,2) intensity grids
+    [scale, offset] are applied inside the kernel — trilinear over the
+    window via separable interpolation matrices
     (BlkAffineFusion.initWithIntensityCoefficients role)."""
     V = len(windows)
+    if not kinds:
+        kinds = ("shift",) * V
+    any_sep = any(k == "sep" for k in kinds)
+    if with_coeffs and any_sep:
+        # the in-kernel coefficient interpolation assumes unit-step lpos;
+        # the planner routes coeffs+diagonal volumes to the per-block path
+        raise ValueError("intensity coefficients with diagonal views are "
+                         "handled by the per-block kernels")
 
     def impl(tiles, fracs, img_dims, borders, ranges, inside_offs, min_i,
-             max_i, coeffs=None, coeff_affs=None):
+             max_i, diags=None, offs=None, coeffs=None, coeff_affs=None):
         if fusion_type == "MAX_INTENSITY":
             acc = jnp.full(out_shape, -jnp.inf, jnp.float32)
         else:
@@ -530,9 +564,14 @@ def make_translation_composite(
                 continue
             P = jnp.pad(tiles[v].astype(jnp.float32),
                         tuple((p, p) for p in pad))
-            val, inside, blend = _composite_one_view(
-                P, fracs[v], img_dims[v], borders[v], ranges[v],
-                inside_offs[v], a, L, n, pad)
+            if kinds[v] == "sep":
+                val, inside, blend = _composite_one_view_sep(
+                    P, diags[v], offs[v], img_dims[v], borders[v], ranges[v],
+                    inside_offs[v], a, L, pad)
+            else:
+                val, inside, blend = _composite_one_view(
+                    P, fracs[v], img_dims[v], borders[v], ranges[v],
+                    inside_offs[v], a, L, n, pad)
             if with_coeffs:
                 # lpos over the window is separable; grid coords through the
                 # diagonal coeff affine stay separable -> trilinear of the

@@ -351,3 +351,41 @@ class TestSeparableDiagonalKernel:
             outs[label] = ds.read_full()
         np.testing.assert_allclose(outs["sep"], outs["gather"], atol=2e-3)
         assert outs["sep"].std() > 0
+
+    def test_composite_handles_anisotropy(self, tmp_path):
+        """The whole-volume device-resident path must now accept diagonal
+        (preserveAnisotropy) views and match the per-block result."""
+        import numpy as np
+
+        from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models import affine_fusion as AF
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+        from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(2, 1, 1), tile_size=(48, 48, 24),
+            overlap=16, jitter=1.5, seed=8, n_beads_per_tile=10)
+        sd = SpimData.load(proj.xml_path)
+        loader = ViewLoader(sd)
+        views = sd.view_ids()
+        af = 2.0
+        aniso = AF.anisotropy_transform(af)
+        bbox = maximal_bounding_box(sd, views, aniso)
+        cp = AF.plan_composite_volume(sd, loader, views, bbox, aniso,
+                                      AF.BlendParams())
+        assert cp is not None and "sep" in cp.kinds, cp and cp.kinds
+        tiles = AF.upload_composite_tiles(loader, cp)
+        vol = np.asarray(AF.dispatch_composite(
+            cp, tiles, "AVG_BLEND", "float32", False, 0.0, 1.0))
+
+        st = ChunkStore.create(str(tmp_path / "blk.n5"), StorageFormat.N5)
+        ds = st.create_dataset("f", bbox.shape, (32, 32, 16), "float32")
+        AF.fuse_volume(sd, loader, views, ds, bbox, block_size=(32, 32, 16),
+                       block_scale=(1, 1, 1), anisotropy_factor=af,
+                       out_dtype="float32", min_intensity=0.0,
+                       max_intensity=1.0, device_resident=False, devices=1)
+        blk = ds.read_full()
+        np.testing.assert_allclose(vol, blk, atol=3e-3)
+        assert vol.std() > 0
