@@ -993,14 +993,24 @@ def main():
             "cpu fallback",
         ))
     partials = []
-    for i, (env_extra, label) in enumerate(attempts):
+    i = 0
+    while i < len(attempts):
+        env_extra, label = attempts[i]
         line, partial = _spawn_child(env_extra, label)
         if line:  # complete result — done
             print(line)
             return 0
         if partial:  # keep as fallback, but let later attempts try for a
             partials.append(partial)  # complete artifact first
-        if i + 1 < len(attempts):
+        elif label.startswith("tpu") and len(attempts) > i + 2:
+            # a TPU attempt that died without even a checkpointed primary
+            # means the tunnel is hung, not slow — don't burn another full
+            # child timeout on it; drop straight to the cpu fallback
+            print("[bench] tpu attempt produced no partial; skipping to "
+                  "cpu fallback", file=sys.stderr)
+            attempts = attempts[:i + 1] + attempts[-1:]
+        i += 1
+        if i < len(attempts):
             time.sleep(10)
     if partials:
         best = max(partials,
