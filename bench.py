@@ -16,8 +16,10 @@ framework's compute from the harness's transport.
 vs_baseline: measured against REAL measurements of reference-equivalent CPU
 implementations on this same host/fixture (numpy+scipy fusion; numpy FFT
 phase correlation with 5-peak wrap disambiguation; scipy DoG + local maxima),
-cached with provenance in BASELINE_MEASURED.json and validated against the
-XLA output before timing.
+RE-MEASURED in the same run as the candidate (the shared host drifts 20-30%
+day to day, so cross-day cached baselines distort the ratio); the cache in
+BASELINE_MEASURED.json records provenance + the previous measurement. The
+XLA output is validated against the baseline implementation before timing.
 
 Robustness: measurements run in a CHILD process with a hard timeout and
 bounded retries; if the accelerator can't be initialized the bench falls
@@ -96,6 +98,15 @@ def _baseline_cache_load():
         with open(BASELINE_FILE) as f:
             return json.load(f)
     return {}
+
+
+# Baselines are RE-MEASURED inside every bench run (BST_BENCH_FRESH_BASELINE
+# defaults on): the shared host's throughput drifts 20-30% day to day, so a
+# cached baseline from another day distorts vs_baseline (r4 verdict weak #7).
+# The cache still records provenance + the previous measurement for
+# comparison; vs_baseline always uses the same-run number.
+def _fresh_baselines() -> bool:
+    return os.environ.get("BST_BENCH_FRESH_BASELINE", "1") == "1"
 
 
 def _baseline_cache_store(cache):
@@ -179,18 +190,9 @@ def measure_baseline(xml_path, threads=None):
     key = _fixture_key(f"fusion-threads{threads}")
     cache = _baseline_cache_load()
     ent = cache.get("fusion")
-    if ent and ent.get("key") == key and ent.get("vox_per_sec", 0) > 0:
+    if (ent and ent.get("key") == key and ent.get("vox_per_sec", 0) > 0
+            and not _fresh_baselines()):
         return float(ent["vox_per_sec"])
-    # migrate the legacy flat-layout cache (round<=3 schema)
-    if cache.get("vox_per_sec") and not ent:
-        legacy_key = hashlib.sha256(
-            json.dumps({"spec": FIXTURE_SPEC, "threads": threads},
-                       sort_keys=True, default=str).encode()).hexdigest()[:16]
-        if cache.get("key") == legacy_key:
-            cache = {"fusion": {**cache, "key": key}}
-            _baseline_cache_store(cache)
-            return float(cache["fusion"]["vox_per_sec"])
-        cache = {}
 
     from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
     from bigstitcher_spark_tpu.io.spimdata import SpimData
@@ -215,6 +217,7 @@ def measure_baseline(xml_path, threads=None):
     dt = time.time() - t0
     vox = int(np.prod(bbox.shape))
     cache["fusion"] = {
+        "previous_vox_per_sec": (ent or {}).get("vox_per_sec"),
         "key": key,
         "vox_per_sec": round(vox / dt, 1),
         "voxels": vox,
@@ -288,14 +291,18 @@ def measure_phasecorr_baseline(jobs):
     cache = _baseline_cache_load()
     key = _fixture_key("phasecorr")
     ent = cache.get("phasecorr")
-    if ent and ent.get("key") == key and ent.get("pairs_per_sec", 0) > 0:
+    if (ent and ent.get("key") == key and ent.get("pairs_per_sec", 0) > 0
+            and not _fresh_baselines()):
         return float(ent["pairs_per_sec"])
     _np_phasecorr_pair(jobs[0].crop_a, jobs[0].crop_b)  # warm numpy/scipy
-    t0 = time.time()
-    for j in jobs:
-        _np_phasecorr_pair(j.crop_a, j.crop_b)
-    dt = time.time() - t0
+    dt = float("inf")
+    for _ in range(3):  # best-of-3 both sides: damp shared-host noise
+        t0 = time.time()
+        for j in jobs:
+            _np_phasecorr_pair(j.crop_a, j.crop_b)
+        dt = min(dt, time.time() - t0)
     cache["phasecorr"] = {
+        "previous_pairs_per_sec": (ent or {}).get("pairs_per_sec"),
         "key": key,
         "pairs_per_sec": round(len(jobs) / dt, 3),
         "pairs": len(jobs),
@@ -333,29 +340,20 @@ def _stitch_jobs(xml_path):
 
 
 def measure_phasecorr(xml_path):
-    """TPU (or fallback-CPU XLA) pairs/sec on the same crops, steady state."""
-    import numpy as np
-
-    from bigstitcher_spark_tpu.models.stitching import (
-        _fft_shape, _stitch_one_bucket,
-    )
+    """TPU (or fallback-CPU XLA) pairs/sec on the same crops, steady state.
+    Uses the production ``stitch_jobs`` pipeline: all shape buckets'
+    device programs dispatch before host refinement starts, so refinement
+    of bucket k overlaps the FFTs of bucket k+1."""
+    from bigstitcher_spark_tpu.models.stitching import stitch_jobs
 
     sd, jobs, params = _stitch_jobs(xml_path)
-    buckets = {}
-    for j in jobs:
-        shp = _fft_shape(np.maximum(j.crop_a.shape, j.crop_b.shape))
-        buckets.setdefault(shp, []).append(j)
 
-    def run_all():
-        out = []
-        for shp, bjobs in sorted(buckets.items()):
-            out.extend(_stitch_one_bucket(sd, bjobs, shp, params))
-        return out
-
-    run_all()  # compile
-    t0 = time.time()
-    results = run_all()
-    dt = time.time() - t0
+    stitch_jobs(sd, jobs, params)  # compile
+    dt = float("inf")
+    for _ in range(3):  # best-of-3, matching the baseline's treatment
+        t0 = time.time()
+        results = stitch_jobs(sd, jobs, params)
+        dt = min(dt, time.time() - t0)
     cpu = measure_phasecorr_baseline(jobs)
     return {
         "metric": "phasecorr_pairs_per_sec",
@@ -369,13 +367,17 @@ def measure_phasecorr(xml_path):
 
 def measure_dog_baseline(xml_path):
     """CPU DoG detection vox/sec: scipy gaussian blurs, subtraction,
-    3^3 local maxima, threshold, quadratic subpixel fit."""
+    3^3 local maxima, threshold, quadratic subpixel fit. Intensity bounds
+    are explicit (0, 65535) on both sides — the reference makes
+    --minIntensity/--maxIntensity REQUIRED options
+    (SparkInterestPointDetection.java:140-144)."""
     import numpy as np
 
     cache = _baseline_cache_load()
-    key = _fixture_key("dog")
+    key = _fixture_key("dog-explicit-minmax")
     ent = cache.get("dog")
-    if ent and ent.get("key") == key and ent.get("vox_per_sec", 0) > 0:
+    if (ent and ent.get("key") == key and ent.get("vox_per_sec", 0) > 0
+            and not _fresh_baselines()):
         return float(ent["vox_per_sec"])
 
     from scipy.ndimage import gaussian_filter, maximum_filter
@@ -383,7 +385,7 @@ def measure_dog_baseline(xml_path):
     from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
     from bigstitcher_spark_tpu.io.spimdata import SpimData
     from bigstitcher_spark_tpu.models.detection import (
-        DetectionParams, _ViewPlan, _estimate_min_max,
+        DetectionParams, _ViewPlan,
     )
     from bigstitcher_spark_tpu.ops.dog import DOG_K
 
@@ -391,34 +393,44 @@ def measure_dog_baseline(xml_path):
     loader = ViewLoader(sd)
     params = DetectionParams()
     s1, s2 = params.sigma, params.sigma * DOG_K
-    total_vox = 0
-    t_total = 0.0
-    n_spots = 0
-    for v in sd.view_ids():
-        plan = _ViewPlan(loader, v, params.downsampling)
-        # the timed region includes the volume read: the TPU side's
-        # detect_interest_points also pays its IO inside the measurement
-        t0 = time.time()
-        img = plan.read_det_block(loader, (0, 0, 0), plan.det_dims)
-        lo, hi = _estimate_min_max(loader, v)
-        norm = (img - lo) / max(hi - lo, 1e-20)
-        g1 = gaussian_filter(norm, s1, mode="nearest")
-        g2 = gaussian_filter(norm, s2, mode="nearest")
-        dog = (g1 - g2) / (DOG_K - 1.0)
-        is_max = (dog == maximum_filter(dog, size=3, mode="nearest"))
-        cand = is_max & (dog > params.threshold / 2)
-        pts = np.argwhere(cand)
-        for p in pts:  # quadratic subpixel refinement per spot
-            if np.any(p == 0) or np.any(p == np.array(dog.shape) - 1):
-                continue
-            for d in range(3):
-                lo_i = tuple(p + np.eye(3, dtype=int)[d] * -1)
-                hi_i = tuple(p + np.eye(3, dtype=int)[d])
-                _ = 0.5 * (dog[lo_i] - dog[hi_i])
-        n_spots += len(pts)
-        t_total += time.time() - t0
-        total_vox += int(np.prod(plan.det_dims))
+
+    def one_pass():
+        total_vox = 0
+        t_total = 0.0
+        n_spots = 0
+        for v in sd.view_ids():
+            plan = _ViewPlan(loader, v, params.downsampling)
+            # the timed region includes the volume read: the TPU side's
+            # detect_interest_points also pays its IO inside the measurement
+            t0 = time.time()
+            img = plan.read_det_block(loader, (0, 0, 0), plan.det_dims)
+            lo, hi = 0.0, 65535.0
+            norm = (img - lo) / max(hi - lo, 1e-20)
+            g1 = gaussian_filter(norm, s1, mode="nearest")
+            g2 = gaussian_filter(norm, s2, mode="nearest")
+            dog = (g1 - g2) / (DOG_K - 1.0)
+            is_max = (dog == maximum_filter(dog, size=3, mode="nearest"))
+            cand = is_max & (dog > params.threshold / 2)
+            pts = np.argwhere(cand)
+            for p in pts:  # quadratic subpixel refinement per spot
+                if np.any(p == 0) or np.any(p == np.array(dog.shape) - 1):
+                    continue
+                for d in range(3):
+                    lo_i = tuple(p + np.eye(3, dtype=int)[d] * -1)
+                    hi_i = tuple(p + np.eye(3, dtype=int)[d])
+                    _ = 0.5 * (dog[lo_i] - dog[hi_i])
+            n_spots += len(pts)
+            t_total += time.time() - t0
+            total_vox += int(np.prod(plan.det_dims))
+        return total_vox, t_total, n_spots
+
+    total_vox, t_total, n_spots = one_pass()
+    for _ in range(2):  # best-of-3 both sides: damp shared-host noise
+        tv, tt, ns = one_pass()
+        if tt < t_total:
+            total_vox, t_total, n_spots = tv, tt, ns
     cache["dog"] = {
+        "previous_vox_per_sec": (ent or {}).get("vox_per_sec"),
         "key": key,
         "vox_per_sec": round(total_vox / t_total, 1),
         "voxels": total_vox,
@@ -429,7 +441,9 @@ def measure_dog_baseline(xml_path):
             "x2 (computeSigmas), subtraction, 3^3 maximum_filter extrema, "
             "threshold, per-spot quadratic subpixel probe. Volume read "
             "included in the timed region (the TPU side pays its IO too); "
-            "same detection-resolution volumes as the TPU path."
+            "same detection-resolution volumes as the TPU path; explicit "
+            "minIntensity=0/maxIntensity=65535 both sides (required "
+            "options in the reference)."
         ),
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
@@ -449,14 +463,17 @@ def measure_dog(xml_path):
     sd = SpimData.load(xml_path)
     loader = ViewLoader(sd)
     views = sd.view_ids()
-    params = DetectionParams()
+    params = DetectionParams(min_intensity=0.0, max_intensity=65535.0)
     total_vox = sum(
         int(np.prod(_ViewPlan(loader, v, params.downsampling).det_dims))
         for v in views)
     detect_interest_points(sd, loader, views, params, progress=False)  # warm
-    t0 = time.time()
-    dets = detect_interest_points(sd, loader, views, params, progress=False)
-    dt = time.time() - t0
+    dt = float("inf")
+    for _ in range(3):  # best-of-3, matching the baseline's treatment
+        t0 = time.time()
+        dets = detect_interest_points(sd, loader, views, params,
+                                      progress=False)
+        dt = min(dt, time.time() - t0)
     cpu = measure_dog_baseline(xml_path)
     n_spots = sum(len(d.points) for d in dets)
     return {
@@ -592,7 +609,8 @@ def measure_multitp():
     cache = _baseline_cache_load()
     key = _fixture_key(f"multitp-{MULTITP_SPEC}")
     ent = cache.get("multitp")
-    if ent and ent.get("key") == key and ent.get("vox_per_sec", 0) > 0:
+    if (ent and ent.get("key") == key and ent.get("vox_per_sec", 0) > 0
+            and not _fresh_baselines()):
         base = float(ent["vox_per_sec"])
     else:
         grid = create_grid(bbox.shape, (64, 64, 32), (64, 64, 32))
@@ -607,6 +625,7 @@ def measure_multitp():
         bdt = time.time() - t0
         base = vox / bdt
         cache["multitp"] = {
+            "previous_vox_per_sec": (ent or {}).get("vox_per_sec"),
             "key": key, "vox_per_sec": round(base, 1), "voxels": vox,
             "seconds": round(bdt, 3),
             "method": ("reference-equivalent numpy fusion "
@@ -758,7 +777,8 @@ def measure_nonrigid():
     cache = _baseline_cache_load()
     key = _fixture_key(f"nonrigid-{NONRIGID_SPEC}")
     ent = cache.get("nonrigid")
-    if ent and ent.get("key") == key and ent.get("vox_per_sec", 0) > 0:
+    if (ent and ent.get("key") == key and ent.get("vox_per_sec", 0) > 0
+            and not _fresh_baselines()):
         base = float(ent["vox_per_sec"])
     else:
         t0 = time.time()
@@ -772,6 +792,7 @@ def measure_nonrigid():
             f"nonrigid XLA disagrees with numpy baseline: "
             f"median|diff|={np.median(diff):.4f}")
         cache["nonrigid"] = {
+            "previous_vox_per_sec": (ent or {}).get("vox_per_sec"),
             "key": key, "vox_per_sec": round(base, 1), "voxels": vox,
             "seconds": round(bdt, 3),
             "method": ("reference-equivalent numpy non-rigid fusion: shared "
@@ -865,7 +886,9 @@ def child_main():
         "vs_baseline": round(vox_per_sec / baseline, 3),
         "platform": jax.devices()[0].platform,
         "baseline_vox_per_sec": round(baseline, 1),
-        "baseline_provenance": "BASELINE_MEASURED.json (measured, this host)",
+        "baseline_provenance": (
+            "measured in this run (same host, same process weather); "
+            "history in BASELINE_MEASURED.json"),
         "best_of_runs": FUSION_RUNS,
         "spans": best_spans,
         "extra_metrics": [],

@@ -108,17 +108,23 @@ class _ViewPlan:
         lvl_dims = loader.open(view, lvl).shape
         self.det_dims = tuple(int(s) // r for s, r in zip(lvl_dims, self.rel))
 
+    def read_raw_block(self, loader: ViewLoader, offset, shape) -> np.ndarray:
+        """Read the LEVEL-resolution voxels backing a detection-res box
+        (mirror-padded outside the image), native dtype: level voxels
+        [o*rel, (o+s)*rel) — the shared geometry for both the host-pooled
+        and device-pooled paths."""
+        lvl_off = [int(o) * r for o, r in zip(offset, self.rel)]
+        lvl_shape = [int(s) * r for s, r in zip(shape, self.rel)]
+        return _read_mirror(loader, self.view, self.level, lvl_off, lvl_shape)
+
     def read_det_block(self, loader: ViewLoader, offset, shape) -> np.ndarray:
         """Read a detection-res box (mirror-padded outside the image): level
         voxels [o*rel, (o+s)*rel) average-pooled by ``rel``
         (openAndDownsample, SparkInterestPointDetection.java:998-1118)."""
-        rel = self.rel
-        lvl_off = [int(o) * r for o, r in zip(offset, rel)]
-        lvl_shape = [int(s) * r for s, r in zip(shape, rel)]
-        raw = _read_mirror(loader, self.view, self.level, lvl_off, lvl_shape)
-        if all(r == 1 for r in rel):
+        raw = self.read_raw_block(loader, offset, shape)
+        if all(r == 1 for r in self.rel):
             return raw.astype(np.float32)
-        return np.asarray(downsample_block(raw.astype(np.float32), rel))
+        return np.asarray(downsample_block(raw.astype(np.float32), self.rel))
 
 
 def _read_mirror(loader: ViewLoader, view, level, offset, shape) -> np.ndarray:
@@ -241,19 +247,22 @@ def _estimate_min_max(loader: ViewLoader, view: ViewId) -> tuple[float, float]:
     return float(img.min()), float(img.max())
 
 
-def _make_dog_kernel(n_dev: int, params: DetectionParams):
+def _make_dog_kernel(n_dev: int, params: DetectionParams,
+                     rel: tuple[int, int, int] = (1, 1, 1)):
     """DoG kernel over a batch of blocks (compacted top-K output: candidate
     coords + device-refined subpixel positions, ~KB/block across the host
     link instead of two dense volumes); with ``n_dev > 1`` the batch axis is
-    sharded over the device mesh (one/few blocks per device)."""
+    sharded over the device mesh (one/few blocks per device). ``rel``:
+    residual downsampling the kernel applies on device (blocks arrive at
+    level resolution, native dtype)."""
     return _make_dog_kernel_cached(
         n_dev, float(params.sigma), bool(params.find_max),
         bool(params.find_min), int(params.max_candidates_per_block),
-        dog_halo(params.sigma))
+        dog_halo(params.sigma), tuple(int(r) for r in rel))
 
 
 @functools.lru_cache(maxsize=32)
-def _make_dog_kernel_cached(n_dev, sigma, find_max, find_min, k, halo):
+def _make_dog_kernel_cached(n_dev, sigma, find_max, find_min, k, halo, rel):
     """lru_cache'd so repeated detections in one process (multi-run benches,
     detection+nonrigid pipelines) reuse the sharded jit instead of
     recompiling (same defect class as the nonrigid kernel, fixed r4)."""
@@ -266,14 +275,14 @@ def _make_dog_kernel_cached(n_dev, sigma, find_max, find_min, k, halo):
             with profiling.span("detection.kernel"):
                 return dog_block_topk_batch(
                     blocks, lo, hi, thr, origins, params.sigma,
-                    params.find_max, params.find_min, k, halo)
+                    params.find_max, params.find_min, k, halo, rel)
         return kernel
 
     mesh = make_mesh(n_dev)
     fn = shard_jit(
         lambda b, l, h, t, o: dog_block_topk_batch_impl(
             b, l, h, t, o, params.sigma, params.find_max, params.find_min,
-            k, halo),
+            k, halo, rel),
         mesh, n_in=5, n_out=5,
     )
 
@@ -301,13 +310,18 @@ def detect_interest_points(
 
     plans = {v: _ViewPlan(loader, v, ds) for v in views}
     minmax = {}
+    need = [v for v in views
+            if params.min_intensity is None or params.max_intensity is None]
+    ests: dict[ViewId, tuple[float, float]] = {}
+    if need:  # estimation reads are independent -> overlap them
+        with ThreadPoolExecutor(max_workers=min(8, len(need))) as mpool:
+            ests = dict(zip(need, mpool.map(
+                lambda v: _estimate_min_max(loader, v), need)))
     for v in views:
-        if params.min_intensity is not None and params.max_intensity is not None:
-            minmax[v] = (params.min_intensity, params.max_intensity)
-        else:
-            lo, hi = _estimate_min_max(loader, v)
-            minmax[v] = (params.min_intensity if params.min_intensity is not None else lo,
-                         params.max_intensity if params.max_intensity is not None else hi)
+        lo, hi = ests.get(v, (0.0, 0.0))
+        minmax[v] = (
+            params.min_intensity if params.min_intensity is not None else lo,
+            params.max_intensity if params.max_intensity is not None else hi)
 
     overlap_boxes: dict[ViewId, list[Interval]] = {}
     jobs: list[_BlockJob] = []
@@ -344,19 +358,26 @@ def detect_interest_points(
 
     n_dev = devices if devices is not None else len(jax.devices())
     per_dev = max(1, params.batch_size // max(n_dev, 1))
-    kernel_fn = _make_dog_kernel(n_dev, params)
 
     def build(job: _BlockJob):
         v = view_list[job.view_idx]
         plan = plans[v]
         off = [m - halo for m in job.core.min]
         shape = [s + 2 * halo for s in job.core.shape]
-        raw = plan.read_det_block(loader, off, shape)
         if params.median_radius > 0:
+            raw = plan.read_det_block(loader, off, shape)
             raw = _median_background_divide(raw, params.median_radius,
                                             exact=params.median_exact)
+            raw = raw.astype(np.float32)
+        else:
+            # ship the LEVEL-resolution block in its native dtype; the
+            # kernel pools by ``rel`` + normalizes on device (half the
+            # wire bytes, no separate downsample dispatch)
+            raw = plan.read_raw_block(loader, off, shape)
+            if raw.dtype.byteorder == ">":  # JAX rejects big-endian (HDF5)
+                raw = raw.astype(raw.dtype.newbyteorder("="))
         lo, hi = minmax[v]
-        return (raw.astype(np.float32), np.float32(lo), np.float32(hi),
+        return (raw, np.float32(lo), np.float32(hi),
                 np.float32(params.threshold),
                 np.array([m - halo for m in job.core.min], np.int32))
 
@@ -390,13 +411,29 @@ def detect_interest_points(
 
     pool = ThreadPoolExecutor(max_workers=8)
     try:
+        # bucket by (det-res block shape, residual factors, input dtype):
+        # one compiled kernel per bucket (median path pre-pools on host,
+        # so its kernel sees rel=(1,1,1) float32 det-res blocks)
         buckets: dict[tuple, list[_BlockJob]] = {}
         for job in jobs:
+            plan = plans[view_list[job.view_idx]]
+            if params.median_radius > 0:
+                rel, dt = (1, 1, 1), "<f4"
+            else:
+                rel = plan.rel
+                dt = np.dtype(loader.open(plan.view, plan.level).dtype
+                              ).newbyteorder("=").str
             shp = tuple(s + 2 * halo for s in job.core.shape)
-            buckets.setdefault(shp, []).append(job)
-        for shp, bjobs in sorted(buckets.items()):
+            buckets.setdefault((shp, rel, dt), []).append(job)
+        for (shp, rel, dt), bjobs in sorted(buckets.items()):
+            kernel_fn = _make_dog_kernel(n_dev, params, rel)
+            # level-res inputs are prod(rel) x larger per det-voxel than the
+            # pooled float32 blocks batch_size was tuned for — scale the
+            # per-device packing down so batch device memory stays bounded
+            rel_vol = int(np.prod(rel))
             run_sharded_batches(bjobs, build, kernel_fn, consume, n_dev, pool,
-                                label="detection batch", per_dev=per_dev)
+                                label="detection batch",
+                                per_dev=max(1, per_dev // rel_vol))
     finally:
         pool.shutdown(wait=True)
 

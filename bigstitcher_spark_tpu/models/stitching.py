@@ -11,7 +11,7 @@ the XML with a registration hash for solver staleness checks
 Shift semantics (used by the solver): a stored result with shift S means the
 per-view correction translations must satisfy ``c_A - c_B = S`` — S is the
 world-space displacement by which group B's current render is offset against
-group A's (derivation in ``_stitch_one_bucket``).
+group A's (derivation in ``_refine_bucket``).
 """
 
 from __future__ import annotations
@@ -310,28 +310,60 @@ def stitch_all_pairs(
         if job is not None:
             jobs.append(job)
 
-    # bucket by padded FFT shape -> one compile per bucket
+    return stitch_jobs(sd, jobs, params)
+
+
+def stitch_jobs(sd, jobs: list[_PairJob], params: StitchingParams
+                ) -> list[PairwiseStitchingResult]:
+    """Run the device PCM + host refinement pipeline over prepared jobs.
+
+    Device programs are dispatched ahead of the host refinement loop
+    (JAX dispatch is async), so refinement of batch k overlaps the device
+    FFTs of batch k+1 — but only a bounded window of batches is in flight
+    at once: each dispatched batch pins its padded crop stacks until it
+    executes, so dispatch-everything would make peak device memory grow
+    with the total pair count instead of the batch size."""
     buckets: dict[tuple, list[_PairJob]] = {}
     for j in jobs:
         shp = _fft_shape(np.maximum(j.crop_a.shape, j.crop_b.shape))
         buckets.setdefault(shp, []).append(j)
 
-    results: list[PairwiseStitchingResult] = []
+    chunks = []
     for shp, bjobs in sorted(buckets.items()):
         for i in range(0, len(bjobs), params.batch_size):
-            chunk = bjobs[i:i + params.batch_size]
-            results.extend(_stitch_one_bucket(sd, chunk, shp, params))
+            chunks.append((shp, bjobs[i:i + params.batch_size]))
+
+    window = 2  # double buffering: refine batch k while k+1 computes
+    in_flight: list[tuple] = []
+    results: list[PairwiseStitchingResult] = []
+
+    def drain_one():
+        shp, chunk, peaks_dev = in_flight.pop(0)
+        with profiling.span("stitching.kernel_sync"):
+            peaks = np.asarray(peaks_dev)  # blocks on the device program
+        results.extend(_refine_bucket(sd, chunk, shp, peaks, params))
+
+    for shp, chunk in chunks:
+        with profiling.span("stitching.kernel"):
+            in_flight.append((shp, chunk,
+                              _dispatch_bucket(chunk, shp, params)))
+        if len(in_flight) >= window:
+            drain_one()
+    while in_flight:
+        drain_one()
     return results
 
 
-def _stitch_one_bucket(sd, jobs: list[_PairJob], shp, params) -> list[PairwiseStitchingResult]:
+def _dispatch_bucket(jobs: list[_PairJob], shp, params):
     a = np.stack([pad_to(j.crop_a, shp) for j in jobs])
     b = np.stack([pad_to(j.crop_b, shp) for j in jobs])
     ext_a = np.stack([np.array(j.crop_a.shape, np.int32) for j in jobs])
     ext_b = np.stack([np.array(j.crop_b.shape, np.int32) for j in jobs])
-    with profiling.span("stitching.kernel"):
-        peaks = np.asarray(pcm_peaks_batch(
-            a, b, ext_a, ext_b, params.peaks_to_check, 0.25))
+    return pcm_peaks_batch(a, b, ext_a, ext_b, params.peaks_to_check, 0.25)
+
+
+def _refine_bucket(sd, jobs: list[_PairJob], shp, peaks,
+                   params) -> list[PairwiseStitchingResult]:
     # per-peak true-correlation scoring + subpixel on the overlap slices
     # (host, float64 — see ops/phasecorr.refine_peaks); numpy reductions
     # release the GIL, so pairs refine in parallel
@@ -350,13 +382,22 @@ def _stitch_one_bucket(sd, jobs: list[_PairJob], shp, params) -> list[PairwiseSt
             min_overlap=min_ov, subpixel=params.subpixel)
 
     with profiling.span("stitching.refine"):
-        if len(jobs) > 1:
+        # bound concurrent scorers by their SAT footprint: each refine
+        # builds 4 float64 summed-area tables (~32 B/crop voxel), so an
+        # unbounded 8-thread pool over huge crops would hold gigabytes of
+        # transient tables at once
+        sat_bytes = 32 * max(int(np.prod(j.crop_a.shape))
+                             + int(np.prod(j.crop_b.shape)) for j in jobs)
+        budget = max(1, int(2e9 // max(sat_bytes, 1)))
+        workers = min(8, len(jobs), budget)
+        if workers > 1:
             from concurrent.futures import ThreadPoolExecutor
 
-            with ThreadPoolExecutor(max_workers=min(8, len(jobs))) as pool:
+            with ThreadPoolExecutor(max_workers=workers) as pool:
                 list(pool.map(_refine, range(len(jobs))))
         else:
-            _refine(0)
+            for k in range(len(jobs)):
+                _refine(k)
 
     ds = np.array(params.downsampling, np.float64)
     out = []

@@ -291,8 +291,19 @@ def _localize_quadratic_device(dog, p0, valid, max_moves: int = 4):
     return result, value
 
 
+def _pool_mean(x: jnp.ndarray, rel: tuple[int, int, int]) -> jnp.ndarray:
+    """Average-pool by integer factors: the SHARED downsample kernel, traced
+    inside the DoG program (a jitted fn called during tracing inlines into
+    the same XLA computation), so the device pooling stays bit-identical to
+    the host path's ``read_det_block`` pooling."""
+    from .downsample import downsample_block
+
+    return downsample_block(x, tuple(int(r) for r in rel))
+
+
 def dog_block_topk_impl(block, min_i, max_i, threshold, origin, sigma,
-                        find_max=True, find_min=False, k=2048, halo=0):
+                        find_max=True, find_min=False, k=2048, halo=0,
+                        rel=(1, 1, 1)):
     """DoG + extrema + device-side subpixel, compacted to the K strongest
     candidates. Returns (idx (K,3) int32 base voxels, sub (K,3) float32
     subpixel coords, val (K,) refined response, valid (K,) bool,
@@ -300,7 +311,15 @@ def dog_block_topk_impl(block, min_i, max_i, threshold, origin, sigma,
 
     ``halo``: static halo width; extrema in the halo belong to neighboring
     blocks, so they are masked out BEFORE top-K — they must neither consume
-    the K budget nor inflate the truncation count."""
+    the K budget nor inflate the truncation count.
+
+    ``rel``: residual downsampling factors applied ON DEVICE before
+    everything else (openAndDownsample's in-memory averaging,
+    SparkInterestPointDetection.java:1094-1114) — the block arrives at
+    level resolution in its native dtype, so the wire carries uint16 and
+    the pool/normalize/DoG chain is one fused program."""
+    if any(int(r) != 1 for r in rel):
+        block = _pool_mean(block, rel)
     dog, mask = dog_block(block, min_i, max_i, threshold, sigma,
                           find_max, find_min, origin)
     if halo > 0:
@@ -322,15 +341,16 @@ def dog_block_topk_impl(block, min_i, max_i, threshold, origin, sigma,
 
 def dog_block_topk_batch_impl(blocks, min_i, max_i, threshold, origins,
                               sigma, find_max=True, find_min=False, k=2048,
-                              halo=0):
+                              halo=0, rel=(1, 1, 1)):
     return jax.vmap(
         lambda b, lo, hi, t, o: dog_block_topk_impl(
-            b, lo, hi, t, o, sigma, find_max, find_min, k, halo)
+            b, lo, hi, t, o, sigma, find_max, find_min, k, halo, rel)
     )(blocks, min_i, max_i, threshold, origins)
 
 
 dog_block_topk_batch = functools.partial(
-    jax.jit, static_argnames=("sigma", "find_max", "find_min", "k", "halo")
+    jax.jit,
+    static_argnames=("sigma", "find_max", "find_min", "k", "halo", "rel"),
 )(dog_block_topk_batch_impl)
 
 

@@ -31,10 +31,16 @@ import numpy as np
 
 
 def _local_maxima(pcm: jnp.ndarray) -> jnp.ndarray:
-    """Mask of voxels that are >= all neighbors in their 3x3x3 window."""
-    pooled = jax.lax.reduce_window(
-        pcm, -jnp.inf, jax.lax.max, (3, 3, 3), (1, 1, 1), "SAME"
-    )
+    """Mask of voxels that are >= all neighbors in their 3x3x3 window,
+    with periodic wrap (the PCM is circular). Separable roll-max: 2
+    elementwise max ops per axis — ``reduce_window`` computes the same
+    thing but lowers ~20x slower on XLA:CPU and no better on TPU."""
+    pooled = pcm
+    for ax in range(3):
+        pooled = jnp.maximum(
+            pooled,
+            jnp.maximum(jnp.roll(pooled, 1, axis=ax),
+                        jnp.roll(pooled, -1, axis=ax)))
     return pcm >= pooled
 
 
@@ -101,22 +107,71 @@ pcm_peaks_batch = jax.jit(
 # ---------------------------------------------------------------------------
 
 
-def _r_candidate(a, b, ext_a, ext_b, s, min_overlap) -> float:
+def _sat(x: np.ndarray) -> np.ndarray:
+    """3-D summed-area table with a zero border: S[i,j,k] = sum of
+    x[:i,:j,:k]; box sums become 8 corner lookups. Cumsums run on
+    contiguous arrays (cumsum into a strided border view is ~5x slower)."""
+    c = np.cumsum(np.cumsum(np.cumsum(x, 0, dtype=np.float64), 1), 2)
+    S = np.zeros(tuple(s + 1 for s in x.shape), np.float64)
+    S[1:, 1:, 1:] = c
+    return S
+
+
+def _box_sum(S: np.ndarray, lo, hi) -> float:
+    x0, y0, z0 = int(lo[0]), int(lo[1]), int(lo[2])
+    x1, y1, z1 = int(hi[0]), int(hi[1]), int(hi[2])
+    return (S[x1, y1, z1] - S[x0, y1, z1] - S[x1, y0, z1] - S[x1, y1, z0]
+            + S[x0, y0, z1] + S[x0, y1, z0] + S[x1, y0, z0] - S[x0, y0, z0])
+
+
+class _PearsonScorer:
     """Pearson r of a[x] vs b[x+s] over the rectangular overlap (the
-    reference's per-peak true cross-correlation check)."""
-    lo = np.maximum(0, -s)
-    hi = np.minimum(ext_a, ext_b - s)
-    if np.any(hi - lo < 1) or float(np.prod(hi - lo)) < min_overlap:
-        return -np.inf
-    av = a[tuple(slice(int(lo[d]), int(hi[d])) for d in range(3))]
-    bv = b[tuple(slice(int(lo[d] + s[d]), int(hi[d] + s[d])) for d in range(3))]
-    am = av - av.mean(dtype=np.float64)
-    bm = bv - bv.mean(dtype=np.float64)
-    den = np.sqrt(np.sum(am * am, dtype=np.float64)
-                  * np.sum(bm * bm, dtype=np.float64))
-    if den <= 1e-12:
-        return -1.0
-    return float(np.sum(am * bm, dtype=np.float64) / den)
+    reference's per-peak true cross-correlation check), with the window
+    sums S_a, S_aa, S_b, S_bb served by summed-area tables — only the
+    cross term S_ab costs a pass over the overlap, ~6x less memory
+    traffic per candidate than the naive centered-copy evaluation."""
+
+    def __init__(self, a: np.ndarray, b: np.ndarray):
+        self.a = a
+        self.b = b
+        self.ext_a = np.array(a.shape, np.int64)
+        self.ext_b = np.array(b.shape, np.int64)
+        self.Sa = _sat(a)
+        self.Saa = _sat(a * a)
+        self.Sb = _sat(b)
+        self.Sbb = _sat(b * b)
+
+    def r(self, s, min_overlap) -> float:
+        lo = np.maximum(0, -s)
+        hi = np.minimum(self.ext_a, self.ext_b - s)
+        if np.any(hi - lo < 1):
+            return -np.inf
+        n = float(np.prod(hi - lo))
+        if n < min_overlap:
+            return -np.inf
+        av = self.a[tuple(slice(int(lo[d]), int(hi[d])) for d in range(3))]
+        bv = self.b[tuple(slice(int(lo[d] + s[d]), int(hi[d] + s[d]))
+                          for d in range(3))]
+        s_ab = float(np.einsum("ijk,ijk->", av, bv, dtype=np.float64,
+                               casting="unsafe"))
+        s_a = _box_sum(self.Sa, lo, hi)
+        s_aa = _box_sum(self.Saa, lo, hi)
+        s_b = _box_sum(self.Sb, lo + s, hi + s)
+        s_bb = _box_sum(self.Sbb, lo + s, hi + s)
+        va = s_aa - s_a * s_a / n
+        vb = s_bb - s_b * s_b / n
+        den = np.sqrt(max(va, 0.0) * max(vb, 0.0))
+        if den <= 1e-12:
+            return -1.0
+        return float((s_ab - s_a * s_b / n) / den)
+
+
+def _r_candidate(a, b, ext_a, ext_b, s, min_overlap) -> float:
+    """One-shot Pearson r (kept for API compatibility; batch callers use
+    ``_PearsonScorer`` to amortize the summed-area tables)."""
+    return _PearsonScorer(np.asarray(a, np.float64),
+                          np.asarray(b, np.float64)).r(
+        np.asarray(s, np.int64), min_overlap)
 
 
 def refine_peaks(
@@ -134,15 +189,14 @@ def refine_peaks(
     round's neighbor evaluations instead of recomputing them."""
     a = np.asarray(crop_a, np.float64)
     b = np.asarray(crop_b, np.float64)
-    ext_a = np.array(a.shape, np.int64)
-    ext_b = np.array(b.shape, np.int64)
     N = np.array(fft_shape, np.int64)
+    scorer = _PearsonScorer(a, b)
     memo: dict[tuple, float] = {}
 
     def r_at(s):
         key = tuple(int(v) for v in s)
         if key not in memo:
-            memo[key] = _r_candidate(a, b, ext_a, ext_b, np.asarray(s), min_overlap)
+            memo[key] = scorer.r(np.asarray(s, np.int64), min_overlap)
         return memo[key]
 
     best_s, best_r = np.zeros(3, np.int64), -np.inf

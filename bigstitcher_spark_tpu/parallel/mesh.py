@@ -190,10 +190,14 @@ def run_sharded_batches(
         if nxt < len(batches) and nxt not in prefetched and nxt not in completed:
             prefetched[nxt] = [pool.submit(build, it) for it in batches[nxt]]
         inputs = [f.result() for f in futs]
+        # pad to a multiple of n_dev (the sharding constraint), NOT to the
+        # full group size: a tail batch of 4 on 1 device must not run as 8
+        # blocks of which half are zero work (the jit re-specializes once
+        # per distinct tail size; full batches all share one shape)
         stacked = pad_batch(
             [np.stack([inp[j] for inp in inputs])
              for j in range(len(inputs[0]))],
-            group,
+            -(-len(inputs) // max(n_dev, 1)) * max(n_dev, 1),
         )
         outs = kernel(*stacked)
         if not isinstance(outs, (tuple, list)):

@@ -2,11 +2,12 @@
 # Patient TPU bench capture: retry the axon tunnel for hours (VERDICT r2 #1:
 # "stop treating the bench as an end-of-round event"). Probes cheaply; when
 # the tunnel answers, runs the full bench and saves the artifact to
-# BENCH_TPU_r04.json + the raw log. Does NOT git-commit (the operator does).
+# BENCH_TPU_${TAG}.json + the raw log. Does NOT git-commit (the operator does).
 set -u
 cd /root/repo
 ATTEMPTS=${1:-150}
 SLEEP=${2:-240}
+TAG=${3:-r05}
 for i in $(seq 1 "$ATTEMPTS"); do
   if timeout 150 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d; print('live', d[0].platform)" >/tmp/tpu_probe.log 2>&1; then
     echo "[loop $(date +%T)] tunnel live ($(cat /tmp/tpu_probe.log)), running bench"
@@ -14,10 +15,10 @@ for i in $(seq 1 "$ATTEMPTS"); do
       if grep -q '"platform": "cpu"' /tmp/bench_tpu_out.json; then
         echo "[loop $(date +%T)] bench fell back to cpu; retrying later"
       else
-        cp /tmp/bench_tpu_out.json BENCH_TPU_r04.json
-        cp /tmp/bench_tpu_err.log BENCH_TPU_r04.log
+        cp /tmp/bench_tpu_out.json "BENCH_TPU_${TAG}.json"
+        cp /tmp/bench_tpu_err.log "BENCH_TPU_${TAG}.log"
         echo "[loop $(date +%T)] TPU BENCH CAPTURED:"
-        cat BENCH_TPU_r04.json
+        cat "BENCH_TPU_${TAG}.json"
         exit 0
       fi
     else
