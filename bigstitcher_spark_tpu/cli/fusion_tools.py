@@ -84,7 +84,8 @@ _DTYPES = ("UINT8", "UINT16", "FLOAT32")
 @click.option("-b", "--boundingBox", "bounding_box", default=None,
               help="use a named bounding box from the XML instead of the maximal one")
 @click.option("-c", "--compression", default="zstd",
-              type=click.Choice(["zstd", "gzip", "raw", "blosc", "bzip2", "xz"]))
+              type=click.Choice(["zstd", "gzip", "raw", "blosc", "bzip2", "xz",
+                                 "lz4"]))
 @click.option("-cl", "--compressionLevel", "compression_level", type=int,
               default=None,
               help="codec-specific compression level (CreateFusionContainer "
@@ -100,9 +101,9 @@ def create_fusion_container_cmd(xml, output, storage, data_type, block_size,
     sd = SpimData.load(xml)
     views = select_views_from_kwargs(sd, kwargs)
     storage_format = StorageFormat(storage)
-    if compression == "xz" and storage_format != StorageFormat.N5:
+    if compression in ("xz", "lz4") and storage_format != StorageFormat.N5:
         raise click.ClickException(
-            "xz compression is only available for N5 containers")
+            f"{compression} compression is only available for N5 containers")
     if compression_level is not None:
         compression = f"{compression}:{compression_level}"
 
@@ -466,25 +467,20 @@ def nonrigid_fusion_cmd(output, xml, labels, cpd, alpha, fusion_type,
                 "output pass -x <dataset.xml> and -p/--dataType "
                 "(plus optionally -s, -b, --minIntensity/--maxIntensity, "
                 "--bdv/-xo)") from e
-        from click.testing import CliRunner
-
-        args = ["-x", xml, "-o", output,
-                "-s", storage_opt or "ZARR", "-d", data_type]
-        if bounding_box is not None:
-            args += ["-b", bounding_box]
-        if min_intensity is not None:
-            args += ["--minIntensity", str(min_intensity)]
-        if max_intensity is not None:
-            args += ["--maxIntensity", str(max_intensity)]
-        if bdv:
-            args += ["--bdv"]
-            if xml_out:
-                args += ["-xo", xml_out]
-        r = CliRunner().invoke(create_fusion_container_cmd, args,
-                               catch_exceptions=False)
-        if r.exit_code != 0:
-            raise click.ClickException(
-                f"direct-output container creation failed: {r.output}")
+        # call the container-creation logic as a plain function (the
+        # undecorated click callback) so stdout streams normally and the
+        # view-selection/infrastructure flags given to nonrigid-fusion
+        # carry through to the container bounding box (ADVICE r4)
+        create_fusion_container_cmd.callback(
+            xml=xml, output=output, storage=storage_opt or "ZARR",
+            data_type=data_type, block_size="128,128,128",
+            num_channels_opt=None, num_timepoints_opt=None,
+            bdv=bdv, xml_out=xml_out, multi_res=False, downsampling=(),
+            preserve_anisotropy=False, anisotropy_factor=float("nan"),
+            min_intensity=min_intensity, max_intensity=max_intensity,
+            bounding_box=bounding_box, compression="zstd",
+            compression_level=None, dry_run=False, **kwargs,
+        )
         click.echo(f"direct output: created container at {output}")
         store = open_container(output)
         meta = read_container_meta(store)

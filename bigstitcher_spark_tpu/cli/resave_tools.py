@@ -64,7 +64,8 @@ def parse_pyramid(spec_list) -> list[list[int]] | None:
 @click.option("-ds", "--downsampling", "downsampling", multiple=True,
               help="pyramid steps incl. 1,1,1, e.g. '1,1,1; 2,2,1; 4,4,1'")
 @click.option("-c", "--compression", default="zstd",
-              type=click.Choice(["zstd", "gzip", "raw", "blosc", "bzip2", "xz"]))
+              type=click.Choice(["zstd", "gzip", "raw", "blosc", "bzip2", "xz",
+                                 "lz4"]))
 @click.option("-cl", "--compressionLevel", "compression_level", type=int,
               default=None,
               help="codec-specific compression level (SparkResaveN5 -cl)")
@@ -80,9 +81,9 @@ def resave_cmd(xml, xml_out, out_path, as_n5, block_size, block_scale,
     loader = ViewLoader(sd)
     views = select_views_from_kwargs(sd, kwargs)
     storage_format = StorageFormat.N5 if as_n5 else StorageFormat.ZARR
-    if compression == "xz" and storage_format != StorageFormat.N5:
+    if compression in ("xz", "lz4") and storage_format != StorageFormat.N5:
         raise click.ClickException(
-            "xz compression is only available for N5 containers (--N5)")
+            f"{compression} compression is only available for N5 containers (--N5)")
     if out_path is None:
         ext = "n5" if as_n5 else "zarr"
         out_path = os.path.join(os.path.dirname(os.path.abspath(xml)),
@@ -101,7 +102,14 @@ def resave_cmd(xml, xml_out, out_path, as_n5, block_size, block_scale,
         block_size=bs, block_scale=bsc,
         downsamplings=ds, compression=compression, threads=threads,
     )
-    swap_imgloader(sd, os.path.abspath(out_path), storage_format)
+    from ..io import uris
+
+    # abspath only LOCAL outputs — os.path.abspath would mangle a cloud URI
+    # into '<cwd>/s3:/...' (r5: caught by the real-s3 endpoint test)
+    swap_imgloader(sd,
+                   out_path if uris.has_scheme(out_path)
+                   else os.path.abspath(out_path),
+                   storage_format)
     target = xml_out or xml
     if xml_out is None and os.path.exists(xml):
         shutil.copy2(xml, xml + "~1")  # reference keeps a ~1 backup

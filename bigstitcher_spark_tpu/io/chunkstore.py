@@ -71,9 +71,14 @@ def _split_level(name: str, level: int | None):
 
 def _n5_compression(name: str, level: int | None = None) -> dict:
     """N5 codec factory (reference surface: Lz4/Gzip/Zstd/Blosc/Bzip2/Xz/Raw,
-    util/N5Util.java:82-105; lz4 has no tensorstore n5 codec). ``level`` is
-    the reference's --compressionLevel (codec-specific meaning)."""
+    util/N5Util.java:82-105). ``level`` is the reference's
+    --compressionLevel (codec-specific meaning). lz4 has no tensorstore n5
+    codec — create_dataset/open_dataset route it through the native-only
+    path (io.native_blockio LZ4Block codec)."""
     name, level = _split_level(name.lower(), level)
+    if name == "lz4":
+        return {"type": "lz4",
+                "blockSize": 65536 if level is None else int(level)}
     if name == "zstd":
         return {"type": "zstd"} if level is None else {
             "type": "zstd", "level": int(level)}
@@ -129,20 +134,34 @@ def _decode_pool():
 
 @dataclass
 class Dataset:
-    """A chunked array presented in xyz-first logical order."""
+    """A chunked array presented in xyz-first logical order.
+
+    ``_ts is None`` marks a NATIVE-ONLY dataset (N5 codecs tensorstore has
+    no driver for — lz4): geometry comes from attributes.json and all IO
+    goes through the in-repo codec (io.native_blockio)."""
 
     store: "ChunkStore"
     path: str
-    _ts: Any  # tensorstore.TensorStore or h5py.Dataset
+    _ts: Any  # tensorstore.TensorStore, h5py.Dataset, or None (native-only)
     reversed_axes: bool  # True when on-disk order is C (zarr/hdf5)
+
+    def _n5_attrs(self) -> dict:
+        attrs = self._meta_file_cached("attributes.json")
+        if not attrs or "dimensions" not in attrs:
+            raise ValueError(f"{self.path}: no N5 dataset attributes")
+        return attrs
 
     @property
     def shape(self) -> tuple[int, ...]:
+        if self._ts is None:
+            return tuple(int(v) for v in self._n5_attrs()["dimensions"])
         s = tuple(int(v) for v in self._ts.shape)
         return s[::-1] if self.reversed_axes else s
 
     @property
     def block_size(self) -> tuple[int, ...]:
+        if self._ts is None:
+            return tuple(int(v) for v in self._n5_attrs()["blockSize"])
         if hasattr(self._ts, "chunk_layout"):
             c = self._ts.chunk_layout.read_chunk.shape
         else:  # h5py
@@ -152,6 +171,8 @@ class Dataset:
 
     @property
     def dtype(self) -> np.dtype:
+        if self._ts is None:
+            return np.dtype(self._n5_attrs()["dataType"])
         return np.dtype(self._ts.dtype.numpy_dtype if hasattr(self._ts.dtype, "numpy_dtype") else self._ts.dtype)
 
     def _sel(self, offset: Sequence[int], shape: Sequence[int]):
@@ -163,6 +184,10 @@ class Dataset:
         native = self._native_read(offset, shape)
         if native is not None:
             return native
+        if self._ts is None:
+            raise ValueError(
+                f"{self.path}: native-only dataset (lz4) — read box "
+                f"{offset}+{shape} must lie inside the array bounds")
         sel = self._sel(offset, shape)
         if hasattr(self._ts, "read"):
             data = self._ts[sel].read().result()
@@ -230,6 +255,10 @@ class Dataset:
         io.native_blockio) when available."""
         if self._native_write(data, offset) or self._native_write_zarr(data, offset):
             return
+        if self._ts is None:
+            raise ValueError(
+                f"{self.path}: native-only dataset (lz4) — writes must be "
+                "block-aligned and dtype-matched")
         sel = self._sel(offset, data.shape)
         if self.reversed_axes:
             data = data.transpose(tuple(range(data.ndim))[::-1])
@@ -250,10 +279,12 @@ class Dataset:
         comp = (self._meta_file_cached("attributes.json")
                 or {}).get("compression", {})
         ctype = comp.get("type", "zstd")
-        if ctype not in ("zstd", "raw"):
-            return None
         from . import native_blockio
 
+        if ctype == "lz4":
+            return "lz4" if native_blockio.has_lz4() else None
+        if ctype not in ("zstd", "raw"):
+            return None
         if not native_blockio.available():
             return None
         return ctype
@@ -274,28 +305,33 @@ class Dataset:
             return False
         for d in range(data.ndim):
             o, s = int(offset[d]), int(data.shape[d])
-            if o % block[d] != 0:
+            if o % block[d] != 0 or s <= 0 or o + s > dims[d]:
                 return False
-            if s != min(block[d], dims[d] - o):
-                return False  # must be exactly one full (or edge) block span
-        # the box may span one block only (writers are block-aligned and
-        # compute blocks are handled by callers splitting per storage block)
+            # box must end on a storage-block boundary or the array edge
+            if (o + s) % block[d] != 0 and (o + s) != dims[d]:
+                return False
+        # a compute block may span several storage blocks (blockScale > 1):
+        # split per storage block, each an exact full/edge chunk file
         if any(int(data.shape[d]) > block[d] for d in range(data.ndim)):
             grid = [range(0, int(data.shape[d]), block[d])
                     for d in range(data.ndim)]
             import itertools
 
             for corner in itertools.product(*grid):
-                sub = data[tuple(slice(c, min(c + block[d], data.shape[d]))
-                                 for d, c in enumerate(corner))]
+                sub = data[tuple(
+                    slice(c, min(c + block[d], data.shape[d]))
+                    for d, c in enumerate(corner))]
                 off = [int(offset[d]) + c for d, c in enumerate(corner)]
-                if not self._native_write(sub, off):
+                if not self._native_write(np.ascontiguousarray(sub), off):
                     return False
             return True
         pos = [int(offset[d]) // block[d] for d in range(data.ndim)]
         path = os.path.join(self.store._kvpath(self.path),
                             *[str(p) for p in pos])
-        level = int(comp.get("level", 3)) or 3
+        if ctype == "lz4":  # the level slot carries the LZ4Block blockSize
+            level = int(comp.get("blockSize", 65536))
+        else:
+            level = int(comp.get("level", 3)) or 3
         native_blockio.write_block(path, data, compression=ctype, level=level)
         return True
 
@@ -522,6 +558,32 @@ class ChunkStore:
         block = tuple(min(int(b), int(s)) if int(s) > 0 else int(b)
                       for b, s in zip(block_size, shape))
         if self.format == StorageFormat.N5:
+            comp = _n5_compression(compression, compression_level)
+            if comp["type"] == "lz4":
+                # tensorstore's n5 driver has no lz4 codec: create the
+                # dataset metadata directly and serve IO through the
+                # native LZ4Block codec (reference parity with
+                # util/N5Util.java:87-88)
+                from . import native_blockio
+
+                if not (self.is_local and native_blockio.has_lz4()
+                        and os.environ.get("BST_NATIVE_IO", "1") == "1"):
+                    raise ValueError(
+                        "lz4 N5 datasets need a local store and the native "
+                        "codec (liblz4, BST_NATIVE_IO enabled)")
+                if delete_existing:
+                    self.remove(path)
+                elif self.is_dataset(path):
+                    raise ValueError(f"{path} already exists")
+                self._write_obj(
+                    self._attr_rel(path.strip("/")),
+                    json.dumps({
+                        "dimensions": list(shape),
+                        "blockSize": list(block),
+                        "dataType": dtype,
+                        "compression": comp,
+                    }, indent=0).encode())
+                return Dataset(self, path, None, reversed_axes=False)
             spec = {
                 "driver": "n5",
                 "kvstore": self._dataset_kvstore(path),
@@ -529,7 +591,7 @@ class ChunkStore:
                     "dimensions": list(shape),
                     "blockSize": list(block),
                     "dataType": dtype,
-                    "compression": _n5_compression(compression, compression_level),
+                    "compression": comp,
                 },
                 "create": True,
                 "delete_existing": delete_existing,
@@ -560,8 +622,29 @@ class ChunkStore:
                 "kvstore": self._dataset_kvstore(path),
                 "open": True,
             }
-            return Dataset(self, path, ts.open(spec, context=ts_context()).result(),
-                           reversed_axes=False)
+            try:
+                arr = ts.open(spec, context=ts_context()).result()
+            except ValueError as e:
+                # tensorstore has no n5 lz4 codec: sniff the metadata only
+                # on failure (no extra read on the happy path, and remote
+                # stores get the clear message too) and serve the dataset
+                # natively when possible
+                ctype = self.get_attribute(path.strip("/"),
+                                           "compression/type")
+                if ctype != "lz4":
+                    raise
+                from . import native_blockio
+
+                native_ok = os.environ.get("BST_NATIVE_IO", "1") == "1"
+                if self.is_local and native_blockio.has_lz4() and native_ok:
+                    return Dataset(self, path, None, reversed_axes=False)
+                raise ValueError(
+                    f"{path}: lz4-compressed N5 needs the native codec on "
+                    f"a local store (liblz4 loaded: "
+                    f"{native_blockio.has_lz4()}, local: {self.is_local}, "
+                    f"BST_NATIVE_IO={os.environ.get('BST_NATIVE_IO', '1')})"
+                ) from e
+            return Dataset(self, path, arr, reversed_axes=False)
         spec = {
             "driver": "zarr",
             "kvstore": self._dataset_kvstore(path),

@@ -137,7 +137,22 @@ class CziFile:
             raise ValueError(f"{self.path}: bad subblock segment {sid!r}")
         raw = self._pread(data_off, SUBBLOCK_FIXED.size)
         metadata_size, _attach_size, data_size = SUBBLOCK_FIXED.unpack(raw)
-        header_size = max(256, SUBBLOCK_FIXED.size + self._entry_size(e))
+        # the header size depends on the DirectoryEntry EMBEDDED in the
+        # subblock segment; parse it rather than assuming the file
+        # directory's copy has the same dimension count (ADVICE r4 — a
+        # writer may store extra per-subblock dimensions), falling back to
+        # the directory copy if the embedded bytes don't parse
+        try:
+            emb = self._pread(data_off + SUBBLOCK_FIXED.size,
+                              DIR_ENTRY_FIXED.size)
+            (emb_schema, _pt, _fp, _part, _comp, _pyr,
+             emb_dim_count) = DIR_ENTRY_FIXED.unpack(emb)
+            if emb_schema != b"DV" or not (0 <= emb_dim_count <= 64):
+                raise ValueError("embedded entry not DV")
+            emb_size = DIR_ENTRY_FIXED.size + DIM_ENTRY.size * emb_dim_count
+        except (ValueError, EOFError, struct.error):
+            emb_size = self._entry_size(e)
+        header_size = max(256, SUBBLOCK_FIXED.size + emb_size)
         payload_off = data_off + header_size + metadata_size
         dtype = PIXEL_DTYPES.get(e.pixel_type)
         if dtype is None:

@@ -24,7 +24,7 @@ _SO_PATH = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
 _SRC_DIR = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
                         "native")
 
-COMPRESSION = {"raw": 0, "zstd": 1}
+COMPRESSION = {"raw": 0, "zstd": 1, "lz4": 2}
 
 
 def _load():
@@ -57,6 +57,9 @@ def _load():
         ctypes.c_int64, ctypes.POINTER(ctypes.c_uint32),
         ctypes.POINTER(ctypes.c_int32),
     ]
+    if hasattr(lib, "lz4_available"):
+        lib.lz4_available.restype = ctypes.c_int32
+        lib.lz4_available.argtypes = []
     if hasattr(lib, "zarr_write_chunk_file"):
         lib.zarr_write_chunk_file.restype = ctypes.c_int64
         lib.zarr_write_chunk_file.argtypes = [
@@ -72,6 +75,15 @@ def _load():
 def has_zarr() -> bool:
     lib = _load()
     return lib is not None and hasattr(lib, "zarr_write_chunk_file")
+
+
+def has_lz4() -> bool:
+    """True when the native codec can serve N5 lz4 (LZ4Block) chunks —
+    the library was built with the lz4 path AND liblz4 loads at runtime.
+    Reference codec surface parity: util/N5Util.java:87-88."""
+    lib = _load()
+    return (lib is not None and hasattr(lib, "lz4_available")
+            and bool(lib.lz4_available()))
 
 
 def write_zarr_chunk(

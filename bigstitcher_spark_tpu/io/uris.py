@@ -15,6 +15,11 @@ import os
 # process-wide region default for s3 kvstores (--s3Region equivalent)
 _S3_REGION: list[str | None] = [os.environ.get("BST_S3_REGION") or None]
 
+# custom S3-protocol endpoint (MinIO / on-prem object stores / test fakes);
+# also used by tests to drive tensorstore's REAL s3 code path against a
+# local server instead of AWS
+_S3_ENDPOINT: list[str | None] = [os.environ.get("BST_S3_ENDPOINT") or None]
+
 
 def set_s3_region(region: str | None) -> None:
     _S3_REGION[0] = region or None
@@ -22,6 +27,14 @@ def set_s3_region(region: str | None) -> None:
 
 def get_s3_region() -> str | None:
     return _S3_REGION[0]
+
+
+def set_s3_endpoint(endpoint: str | None) -> None:
+    _S3_ENDPOINT[0] = endpoint or None
+
+
+def get_s3_endpoint() -> str | None:
+    return _S3_ENDPOINT[0]
 
 
 def has_scheme(path: str | os.PathLike) -> bool:
@@ -103,6 +116,8 @@ def kvstore_spec(root: str | os.PathLike, subpath: str = "") -> dict:
                 "path": full + "/" if full else ""}
         if get_s3_region():
             spec["aws_region"] = get_s3_region()
+        if get_s3_endpoint():
+            spec["endpoint"] = get_s3_endpoint()
         return spec
     if scheme == "gs":
         return {"driver": "gcs", "bucket": bucket,

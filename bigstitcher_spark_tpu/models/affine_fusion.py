@@ -723,7 +723,7 @@ def fuse_volume(
 
     import jax
 
-    n_dev = devices if devices is not None else len(jax.devices())
+    n_dev = devices if devices is not None else len(jax.local_devices())
     if n_dev > 1:
         _fuse_volume_sharded(
             sd, loader, views, out_ds, bbox, compute_block, fusion_type,
@@ -734,7 +734,16 @@ def fuse_volume(
         stats.seconds = time.time() - t0
         return stats
 
-    use_composite = device_resident is not False
+    # multi-host with one local device: each process takes its slice of the
+    # block grid (strided partition); the whole-volume composite path is
+    # skipped — it would compute and write the full volume on every host
+    from ..parallel.distributed import partition_items, world
+
+    multi_process = world()[1] > 1
+    if multi_process:
+        grid = partition_items(grid)
+
+    use_composite = device_resident is not False and not multi_process
     vol = None if not use_composite else (
         _try_fuse_volume_device(
             sd, loader, views, bbox, fusion_type,

@@ -356,7 +356,7 @@ def detect_interest_points(
     # SparkInterestPointDetection.java:448-660, strategy P3)
     import jax
 
-    n_dev = devices if devices is not None else len(jax.devices())
+    n_dev = devices if devices is not None else len(jax.local_devices())
     per_dev = max(1, params.batch_size // max(n_dev, 1))
 
     def build(job: _BlockJob):

@@ -93,7 +93,7 @@ def run_sharded_downsample(jobs, read_job, write_job, rel, devices=None,
     shape so one compile serves each shape."""
     import jax
 
-    n_dev = devices if devices is not None else len(jax.devices())
+    n_dev = devices if devices is not None else len(jax.local_devices())
     kernel = make_downsample_kernel(n_dev, rel)
     buckets: dict[tuple, list] = {}
     for job in jobs:

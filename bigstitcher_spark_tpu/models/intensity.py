@@ -51,7 +51,9 @@ class IntensityParams:
     # reference candidate/inlier filters (SparkIntensityMatching.java:51-77)
     min_threshold: float = 1.0        # --minThreshold: discard samples below
     max_threshold: float = float("nan")  # --maxThreshold: discard above
-    min_num_candidates: int = 0       # --minNumCandidates per cell pair
+    # --minNumCandidates per cell pair (SparkIntensityMatching.java:58
+    # default; programmatic callers get the same filtering as the CLI)
+    min_num_candidates: int = 1000
     min_inlier_ratio: float = 0.1     # --minInlierRatio (RANSAC)
     min_num_inliers: int = 10         # --minNumInliers (RANSAC)
     max_trust: float = 3.0            # --maxTrust: drop inliers with residual

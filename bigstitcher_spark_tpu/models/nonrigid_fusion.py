@@ -170,7 +170,7 @@ def fuse_nonrigid_volume(
 
     from ..parallel.mesh import run_sharded_batches
 
-    n_dev = devices if devices is not None else len(jax.devices())
+    n_dev = devices if devices is not None else len(jax.local_devices())
 
     # plan every block up front (host geometry + control-grid fits), then
     # bucket by compiled-kernel signature and batch over the device mesh —

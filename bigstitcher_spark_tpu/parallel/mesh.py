@@ -34,7 +34,12 @@ BLOCK_AXIS = "blocks"
 
 @functools.lru_cache(maxsize=8)
 def _cached_mesh(n_devices: int | None) -> Mesh:
-    devs = list(jax.devices())
+    # LOCAL devices only: under jax.distributed each process works an
+    # independent slice of the grid (partition_items), so its mesh must not
+    # span other hosts' devices — a global mesh fed different per-process
+    # inputs violates the multi-controller SPMD contract (all collectives /
+    # cross-host programs here go through barrier() instead)
+    devs = list(jax.local_devices())
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (BLOCK_AXIS,))

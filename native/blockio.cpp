@@ -16,6 +16,8 @@
 
 #include <zstd.h>
 
+#include <dlfcn.h>
+
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -25,6 +27,204 @@
 #include <cerrno>
 
 namespace {
+
+// ---------------------------------------------------------------------------
+// LZ4 via dlopen (liblz4.so.1 ships without headers on this image) +
+// lz4-java "LZ4Block" stream framing — the wire format of the reference's
+// N5 Lz4Compression (util/N5Util.java:87-88; net.jpountz LZ4BlockOutputStream):
+//   per chunk (<= 64 KiB of raw data):
+//     magic "LZ4Block" (8) | token (1: method 0x10 raw / 0x20 lz4, low
+//     nibble = log2(blockSize)-10) | compressedLen LE u32 | originalLen LE
+//     u32 | xxhash32(seed 0x9747b28c) of the RAW chunk, LE u32 | payload
+//   terminated by an empty frame (originalLen == 0).
+// ---------------------------------------------------------------------------
+
+typedef int (*lz4_compress_fn)(const char*, char*, int, int);
+typedef int (*lz4_decompress_fn)(const char*, char*, int, int);
+typedef int (*lz4_bound_fn)(int);
+lz4_compress_fn p_lz4_compress = nullptr;
+lz4_decompress_fn p_lz4_decompress = nullptr;
+lz4_bound_fn p_lz4_bound = nullptr;
+
+bool lz4_init() {
+  static int state = 0;  // 0 = untried, 1 = ok, -1 = unavailable
+  if (state == 0) {
+    void* h = dlopen("liblz4.so.1", RTLD_NOW);
+    if (!h) h = dlopen("liblz4.so", RTLD_NOW);
+    if (h) {
+      p_lz4_compress =
+          reinterpret_cast<lz4_compress_fn>(dlsym(h, "LZ4_compress_default"));
+      p_lz4_decompress =
+          reinterpret_cast<lz4_decompress_fn>(dlsym(h, "LZ4_decompress_safe"));
+      p_lz4_bound =
+          reinterpret_cast<lz4_bound_fn>(dlsym(h, "LZ4_compressBound"));
+    }
+    state = (p_lz4_compress && p_lz4_decompress && p_lz4_bound) ? 1 : -1;
+  }
+  return state == 1;
+}
+
+// xxhash32 (public spec) — lz4-java checksums raw chunks with seed
+// 0x9747b28c and writes the full 32-bit value little-endian.
+const uint32_t XXH_P1 = 2654435761u, XXH_P2 = 2246822519u,
+               XXH_P3 = 3266489917u, XXH_P4 = 668265263u, XXH_P5 = 374761393u;
+const uint32_t LZ4JAVA_SEED = 0x9747b28cu;
+
+inline uint32_t xxh_rotl(uint32_t x, int r) { return (x << r) | (x >> (32 - r)); }
+inline uint32_t xxh_read_le(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+uint32_t xxhash32(const uint8_t* p, size_t len, uint32_t seed) {
+  const uint8_t* end = p + len;
+  uint32_t h;
+  if (len >= 16) {
+    uint32_t v1 = seed + XXH_P1 + XXH_P2, v2 = seed + XXH_P2, v3 = seed,
+             v4 = seed - XXH_P1;
+    const uint8_t* limit = end - 16;
+    do {
+      v1 = xxh_rotl(v1 + xxh_read_le(p) * XXH_P2, 13) * XXH_P1;
+      p += 4;
+      v2 = xxh_rotl(v2 + xxh_read_le(p) * XXH_P2, 13) * XXH_P1;
+      p += 4;
+      v3 = xxh_rotl(v3 + xxh_read_le(p) * XXH_P2, 13) * XXH_P1;
+      p += 4;
+      v4 = xxh_rotl(v4 + xxh_read_le(p) * XXH_P2, 13) * XXH_P1;
+      p += 4;
+    } while (p <= limit);
+    h = xxh_rotl(v1, 1) + xxh_rotl(v2, 7) + xxh_rotl(v3, 12) + xxh_rotl(v4, 18);
+  } else {
+    h = seed + XXH_P5;
+  }
+  h += static_cast<uint32_t>(len);
+  while (p + 4 <= end) {
+    h = xxh_rotl(h + xxh_read_le(p) * XXH_P3, 17) * XXH_P4;
+    p += 4;
+  }
+  while (p < end) {
+    h = xxh_rotl(h + (*p) * XXH_P5, 11) * XXH_P1;
+    ++p;
+  }
+  h ^= h >> 15;
+  h *= XXH_P2;
+  h ^= h >> 13;
+  h *= XXH_P3;
+  h ^= h >> 16;
+  return h;
+}
+
+const char LZ4B_MAGIC[8] = {'L', 'Z', '4', 'B', 'l', 'o', 'c', 'k'};
+const int64_t LZ4B_HEADER = 8 + 1 + 4 + 4 + 4;
+const int64_t LZ4B_CHUNK = 65536;  // n5 Lz4Compression default blockSize
+const uint8_t LZ4B_METHOD_RAW = 0x10, LZ4B_METHOD_LZ4 = 0x20;
+
+// lz4-java token low nibble: ceil(log2(blockSize)) - 10 (blockSize in
+// [64, 32 MiB] -> compressionLevel in [0, 15])
+inline int64_t lz4b_chunk_size(int32_t level) {
+  return (level >= 64 && level <= (1 << 25)) ? level : LZ4B_CHUNK;
+}
+inline uint8_t lz4b_token_level(int64_t chunk) {
+  uint8_t lvl = 0;
+  while ((int64_t(1) << (lvl + 10)) < chunk && lvl < 15) ++lvl;
+  return lvl;
+}
+
+inline void put_u32_le(uint8_t* p, uint32_t v) {
+  p[0] = static_cast<uint8_t>(v);
+  p[1] = static_cast<uint8_t>(v >> 8);
+  p[2] = static_cast<uint8_t>(v >> 16);
+  p[3] = static_cast<uint8_t>(v >> 24);
+}
+inline uint32_t get_u32_le(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+int64_t lz4block_bound(int64_t raw) {
+  // generous: covers the smallest legal chunk size (64 B -> ~33% frame
+  // overhead when incompressible)
+  return raw + raw / 2 + 1024;
+}
+
+// Encode raw -> LZ4Block stream (frames of ``chunk`` raw bytes). Returns
+// bytes written or <0.
+int64_t lz4block_encode(const uint8_t* raw, int64_t raw_len, uint8_t* out,
+                        int64_t out_cap, int64_t chunk) {
+  if (!lz4_init()) return -8;
+  int64_t off = 0, pos = 0;
+  while (pos < raw_len) {
+    const int n = static_cast<int>(
+        raw_len - pos < chunk ? raw_len - pos : chunk);
+    const int bound = p_lz4_bound(n);
+    if (off + LZ4B_HEADER + bound > out_cap) return -1;
+    uint8_t* hdr = out + off;
+    std::memcpy(hdr, LZ4B_MAGIC, 8);
+    uint8_t* dst = hdr + LZ4B_HEADER;
+    int clen = p_lz4_compress(reinterpret_cast<const char*>(raw + pos),
+                              reinterpret_cast<char*>(dst), n, bound);
+    uint8_t method = LZ4B_METHOD_LZ4;
+    if (clen <= 0 || clen >= n) {  // incompressible: store raw
+      std::memcpy(dst, raw + pos, static_cast<size_t>(n));
+      clen = n;
+      method = LZ4B_METHOD_RAW;
+    }
+    hdr[8] = static_cast<uint8_t>(method | lz4b_token_level(chunk));
+    put_u32_le(hdr + 9, static_cast<uint32_t>(clen));
+    put_u32_le(hdr + 13, static_cast<uint32_t>(n));
+    put_u32_le(hdr + 17, xxhash32(raw + pos, static_cast<size_t>(n),
+                                  LZ4JAVA_SEED));
+    off += LZ4B_HEADER + clen;
+    pos += n;
+  }
+  if (off + LZ4B_HEADER > out_cap) return -1;
+  uint8_t* hdr = out + off;  // terminator frame
+  std::memcpy(hdr, LZ4B_MAGIC, 8);
+  hdr[8] = static_cast<uint8_t>(LZ4B_METHOD_RAW | lz4b_token_level(chunk));
+  put_u32_le(hdr + 9, 0);
+  put_u32_le(hdr + 13, 0);
+  put_u32_le(hdr + 17, 0);
+  return off + LZ4B_HEADER;
+}
+
+// Decode an LZ4Block stream into out (expected_raw bytes). Returns bytes
+// decoded or <0.
+int64_t lz4block_decode(const uint8_t* enc, int64_t enc_len, uint8_t* out,
+                        int64_t expected_raw) {
+  if (!lz4_init()) return -8;
+  int64_t off = 0, pos = 0;
+  while (pos < expected_raw) {
+    if (off + LZ4B_HEADER > enc_len) return -2;
+    const uint8_t* hdr = enc + off;
+    if (std::memcmp(hdr, LZ4B_MAGIC, 8) != 0) return -2;
+    const uint8_t method = hdr[8] & 0xf0;
+    const int64_t clen = get_u32_le(hdr + 9);
+    const int64_t rawn = get_u32_le(hdr + 13);
+    const uint32_t check = get_u32_le(hdr + 17);
+    off += LZ4B_HEADER;
+    if (rawn == 0) break;  // premature terminator
+    if (off + clen > enc_len || pos + rawn > expected_raw) return -2;
+    if (method == LZ4B_METHOD_RAW) {
+      if (clen != rawn) return -2;
+      std::memcpy(out + pos, enc + off, static_cast<size_t>(rawn));
+    } else if (method == LZ4B_METHOD_LZ4) {
+      const int got = p_lz4_decompress(
+          reinterpret_cast<const char*>(enc + off),
+          reinterpret_cast<char*>(out + pos), static_cast<int>(clen),
+          static_cast<int>(rawn));
+      if (got != rawn) return -2;
+    } else {
+      return -2;
+    }
+    if (xxhash32(out + pos, static_cast<size_t>(rawn), LZ4JAVA_SEED) != check)
+      return -9;  // checksum mismatch
+    off += clen;
+    pos += rawn;
+  }
+  return pos;
+}
 
 inline void put_u16_be(uint8_t* p, uint16_t v) {
   p[0] = static_cast<uint8_t>(v >> 8);
@@ -85,10 +285,16 @@ bool mkdirs_for(const std::string& file_path) {
 
 extern "C" {
 
-// Max encoded size for a block of raw_bytes payload.
+// 1 when liblz4 could be loaded (lz4 codec usable), else 0.
+int32_t lz4_available() { return lz4_init() ? 1 : 0; }
+
+// Max encoded size for a block of raw_bytes payload (covers zstd AND the
+// LZ4Block stream framing).
 int64_t n5_encode_bound(int64_t raw_bytes, int32_t ndim) {
-  return 4 + 4 * static_cast<int64_t>(ndim) +
-         static_cast<int64_t>(ZSTD_compressBound(static_cast<size_t>(raw_bytes)));
+  const int64_t zb =
+      static_cast<int64_t>(ZSTD_compressBound(static_cast<size_t>(raw_bytes)));
+  const int64_t lb = lz4block_bound(raw_bytes);
+  return 4 + 4 * static_cast<int64_t>(ndim) + (zb > lb ? zb : lb);
 }
 
 // Encode one N5 block. data: first-axis-fastest element order, NATIVE
@@ -117,6 +323,14 @@ int64_t n5_encode_block(const uint8_t* data, int32_t elem_size,
     if (out_cap < header + static_cast<int64_t>(raw)) return -1;
     std::memcpy(out + header, payload, raw);
     return header + static_cast<int64_t>(raw);
+  }
+  if (compression == 2) {  // lz4 (LZ4Block stream, reference N5 Lz4);
+    // ``level`` carries the reference's Lz4 blockSize (N5Util.java:87-88)
+    const int64_t got = lz4block_encode(payload, static_cast<int64_t>(raw),
+                                        out + header, out_cap - header,
+                                        lz4b_chunk_size(level));
+    if (got < 0) return got;
+    return header + got;
   }
   const size_t cap = static_cast<size_t>(out_cap - header);
   const size_t got = ZSTD_compress(out + header, cap, payload, raw, level);
@@ -151,6 +365,13 @@ int64_t n5_decode_block(const uint8_t* enc, int64_t enc_len, int32_t elem_size,
   if (compression == 0) {
     if (enc_len - header < static_cast<int64_t>(raw)) return -1;
     payload = enc + header;
+  } else if (compression == 2) {
+    tmp.resize(raw);
+    const int64_t got = lz4block_decode(enc + header, enc_len - header,
+                                        reinterpret_cast<uint8_t*>(&tmp[0]),
+                                        static_cast<int64_t>(raw));
+    if (got != static_cast<int64_t>(raw)) return got < 0 ? got : -2;
+    payload = reinterpret_cast<const uint8_t*>(tmp.data());
   } else {
     tmp.resize(raw);
     const size_t got =

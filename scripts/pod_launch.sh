@@ -1,0 +1,63 @@
+#!/bin/bash
+# Multi-host launcher for the block-writing stages (affine-fusion, resave,
+# nonrigid-fusion, downsample) — the role the reference fills with
+# flintstone/spark-janelia (src/main/scripts/flintstone-sge-example.sh:29-119).
+#
+# Every process runs the SAME bst command; jax.distributed wires them into
+# one runtime and each takes its deterministic slice of the block grid
+# (bigstitcher_spark_tpu/parallel/distributed.py). Output chunks are
+# disjoint, so no cross-host traffic happens outside the stage barriers.
+#
+# Usage:
+#   # all N processes on THIS machine (single node, N runtimes):
+#   scripts/pod_launch.sh -n 4 -- affine-fusion -o /data/fused.zarr
+#
+#   # one process per host on a cluster (run on every host, ids 0..N-1):
+#   scripts/pod_launch.sh -n 4 -c head-node:8476 -i $HOST_ID -- \
+#       affine-fusion -o /shared/fused.zarr
+#
+#   # Cloud TPU pod slices: jax autodetects the topology — just export
+#   # BST_DISTRIBUTED=1 and run `bst <tool> ...` on every worker
+#   # (gcloud compute tpus tpu-vm ssh ... --worker=all --command="...").
+#
+# SLURM: sbatch with --ntasks=N and run
+#   scripts/pod_launch.sh -n $SLURM_NTASKS -c $MASTER:8476 -i $SLURM_PROCID -- ...
+set -euo pipefail
+
+NUM=2
+COORD=""
+PID=""
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    -n|--num-processes) NUM="$2"; shift 2 ;;
+    -c|--coordinator)   COORD="$2"; shift 2 ;;
+    -i|--process-id)    PID="$2"; shift 2 ;;
+    --) shift; break ;;
+    *) echo "unknown option $1 (expected -n/-c/-i -- <bst args>)"; exit 2 ;;
+  esac
+done
+[[ $# -gt 0 ]] || { echo "missing bst command after --"; exit 2; }
+
+BST=${BST:-"python -m bigstitcher_spark_tpu.cli.main"}
+
+if [[ -z "$PID" ]]; then
+  # local mode: all N processes on this machine against a local coordinator
+  COORD=${COORD:-"127.0.0.1:$(( 20000 + RANDOM % 20000 ))"}
+  echo "[pod_launch] $NUM local processes, coordinator $COORD"
+  pids=()
+  for i in $(seq 0 $((NUM - 1))); do
+    BST_COORDINATOR="$COORD" BST_NUM_PROCESSES="$NUM" BST_PROCESS_ID="$i" \
+      $BST "$@" > >(sed "s/^/[p$i] /") 2>&1 &
+    pids+=($!)
+  done
+  rc=0
+  for p in "${pids[@]}"; do
+    wait "$p" || rc=$?
+  done
+  exit "$rc"
+fi
+
+[[ -n "$COORD" ]] || { echo "-c coordinator required with -i"; exit 2; }
+echo "[pod_launch] process $PID/$NUM, coordinator $COORD"
+exec env BST_COORDINATOR="$COORD" BST_NUM_PROCESSES="$NUM" \
+     BST_PROCESS_ID="$PID" $BST "$@"

@@ -300,6 +300,47 @@ class TestSeparableDiagonalKernel:
             np.testing.assert_allclose(np.asarray(s_w).reshape(B),
                                        np.asarray(g_w), atol=2e-4)
 
+    def test_sep_matches_gather_on_mirrored_diagonals(self):
+        """Negative (mirrored) diagonal entries must also agree: the
+        per-block bucketing routes mirrored-diagonal views to the sep
+        kernel (is_diagonal does not require positive entries), so the
+        edge-clamped interpolation matrices must handle reversed axes
+        (ADVICE r4 — previously untested)."""
+        import numpy as np
+
+        from bigstitcher_spark_tpu.ops import fusion as F
+
+        rng = np.random.default_rng(6)
+        V, P, B = 3, (40, 36, 28), (24, 24, 16)
+        patches = rng.random((V, *P)).astype(np.float32) * 900
+        affines = np.zeros((V, 3, 4), np.float32)
+        diags = rng.uniform(0.6, 1.7, (V, 3)).astype(np.float32)
+        diags[0, 1] *= -1.0  # mirrored y on view 0
+        diags[2, 0] *= -1.0  # mirrored x on view 2
+        ts = rng.uniform(-3, 6, (V, 3)).astype(np.float32)
+        ts[0, 1] += P[1]  # keep mirrored sampling inside the patch
+        ts[2, 0] += P[0]
+        for i in range(3):
+            affines[:, i, i] = diags[:, i]
+        affines[:, :, 3] = ts
+        offsets = rng.uniform(0, 4, (V, 3)).astype(np.float32)
+        img_dims = np.tile(np.array(P, np.float32) * 1.4, (V, 1))
+        borders = np.zeros((V, 3), np.float32)
+        ranges = np.full((V, 3), 9.0, np.float32)
+        valid = np.ones(V, np.float32)
+
+        for ftype in ("AVG_BLEND", "MAX_INTENSITY", "FIRST_WINS"):
+            g_f, g_w = F.fuse_block(
+                patches, affines, offsets, img_dims, borders, ranges, valid,
+                block_shape=B, fusion_type=ftype)
+            s_f, s_w = F.fuse_block_sep(
+                patches, diags, ts, offsets, img_dims, borders, ranges,
+                valid, block_shape=B, fusion_type=ftype)
+            np.testing.assert_allclose(np.asarray(s_f).reshape(B),
+                                       np.asarray(g_f), atol=2e-3)
+            np.testing.assert_allclose(np.asarray(s_w).reshape(B),
+                                       np.asarray(g_w), atol=2e-4)
+
     def test_anisotropy_fusion_routes_to_sep(self, tmp_path):
         """--preserveAnisotropy over translation-registered tiles: the
         per-block path must take the separable kernel and agree with the
