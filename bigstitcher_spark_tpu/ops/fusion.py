@@ -588,25 +588,34 @@ def make_translation_composite(
                     so = jnp.tensordot(so, m, axes=[[0], [1]])
                 val = so[0] * val + so[1]
             win = tuple(slice(a[d], b[d]) for d in range(3))
+
+            # window updates as slice + combine + dynamic_update_slice with
+            # STATIC starts: jnp's .at[win].add lowers to HLO scatter even
+            # for static windows, and scatter is the classic TPU lowering
+            # cliff (serialized, no vectorization); DUS stays a dense fused
+            # update on every backend
+            starts = tuple(int(a[d]) for d in range(3))
+
+            def win_update(x, new_region):
+                return jax.lax.dynamic_update_slice(x, new_region, starts)
+
             if fusion_type == "AVG":
                 w = inside
             elif fusion_type == "AVG_BLEND":
                 w = inside * blend
             elif fusion_type == "MAX_INTENSITY":
-                region = acc[win]
-                acc = acc.at[win].set(
-                    jnp.maximum(region, jnp.where(inside > 0, val, -jnp.inf)))
-                wsum = wsum.at[win].add(inside)
+                acc = win_update(acc, jnp.maximum(
+                    acc[win], jnp.where(inside > 0, val, -jnp.inf)))
+                wsum = win_update(wsum, wsum[win] + inside)
                 continue
             elif fusion_type in ("FIRST_WINS", "LAST_WINS"):
-                region = acc[win]
-                acc = acc.at[win].set(jnp.where(inside > 0, val, region))
-                wsum = wsum.at[win].add(inside)
+                acc = win_update(acc, jnp.where(inside > 0, val, acc[win]))
+                wsum = win_update(wsum, wsum[win] + inside)
                 continue
             else:
                 raise ValueError(f"unknown fusion type {fusion_type}")
-            acc = acc.at[win].add(val * w)
-            wsum = wsum.at[win].add(w)
+            acc = win_update(acc, acc[win] + val * w)
+            wsum = win_update(wsum, wsum[win] + w)
         if fusion_type in ("MAX_INTENSITY", "FIRST_WINS", "LAST_WINS"):
             fused = jnp.where(wsum > 0, acc, 0.0)
         else:
