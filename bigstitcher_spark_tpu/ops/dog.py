@@ -5,9 +5,11 @@ SparkInterestPointDetection.java:552-568 — two Gaussian blurs (sigma,
 sigma*k), subtraction, 3x3x3 extrema, threshold, quadratic subpixel fit,
 with the image normalized to [0,1] via min/maxIntensity.
 
-TPU design: the blurs are separable 1-D convolutions (three
-``conv_general_dilated`` passes), extrema detection is a 3^3
-``reduce_window`` max/min compared against the response — all dense, static
+TPU design: the blurs are separable 1-D passes (banded-Toeplitz GEMMs on
+the MXU, or one FFT transfer-function product on CPU), the normalization
+is folded into the response scale (the min offset cancels in the kernel
+difference), extrema detection is a separable shifted-slice 3^3
+max/min compared against the response — all dense, static
 shapes, fused by XLA and vmapped over a batch of equally-shaped blocks.
 Detections leave the device as a boolean mask + response volume; the sparse
 tail (argwhere + 3-D quadratic refinement) runs on host where dynamic point
@@ -173,12 +175,13 @@ def dog_block(
 
     Returns (dog float32, mask bool). ``mask`` marks voxels that are a strict
     3x3x3 max of the response above ``threshold`` (or min below -threshold).
-    Input is normalized to [0,1] by min/max intensity first
-    (DoGImgLib2 normalization, SparkInterestPointDetection.java:552-568).
+    The response equals DoG of the [0,1]-normalized input (DoGImgLib2
+    normalization, SparkInterestPointDetection.java:552-568), with the
+    1/(max-min) scale folded into the response instead of a separate
+    normalization pass (the offset cancels; see inline comment).
     ``origin`` is the block's absolute voxel offset (for tie-breaking only).
     """
     x = block.astype(jnp.float32)
-    x = (x - min_intensity) / jnp.maximum(max_intensity - min_intensity, 1e-20)
     s1 = float(sigma)
     s2 = float(sigma) * DOG_K
     k1 = gaussian_kernel_1d(s1)
@@ -187,7 +190,13 @@ def dog_block(
         diff = _dog_response_fft(x, k1, k2)
     else:
         diff = _blur_separable(x, [k1] * 3) - _blur_separable(x, [k2] * 3)
-    dog = diff * (1.0 / (DOG_K - 1.0))
+    # the [min,max]->[0,1] normalization (DoGImgLib2,
+    # SparkInterestPointDetection.java:552-568) commutes with the DoG:
+    # both blur kernels are normalized, so the constant offset cancels in
+    # the difference and only the 1/(max-min) scale survives — folding it
+    # into the response scale saves two full-volume passes over the input
+    dog = diff * (1.0 / (DOG_K - 1.0)
+                  / jnp.maximum(max_intensity - min_intensity, 1e-20))
 
     if origin is None:
         origin = jnp.zeros(3, jnp.int32)
@@ -262,18 +271,23 @@ def _localize_quadratic_device(dog, p0, valid, max_moves: int = 4):
         plus = [_gather3(flat, p + eye[d], shape) for d in range(3)]
         minus = [_gather3(flat, p - eye[d], shape) for d in range(3)]
         g = jnp.stack([0.5 * (plus[d] - minus[d]) for d in range(3)], axis=-1)
-        H = jnp.zeros((p.shape[0], 3, 3), jnp.float32)
-        for d in range(3):
-            H = H.at[:, d, d].set(plus[d] - 2.0 * c + minus[d])
-        for d in range(3):
-            for e in range(d + 1, 3):
-                v = 0.25 * (
-                    _gather3(flat, p + eye[d] + eye[e], shape)
-                    - _gather3(flat, p + eye[d] - eye[e], shape)
-                    - _gather3(flat, p - eye[d] + eye[e], shape)
-                    + _gather3(flat, p - eye[d] - eye[e], shape))
-                H = H.at[:, d, e].set(v)
-                H = H.at[:, e, d].set(v)
+        diag = [plus[d] - 2.0 * c + minus[d] for d in range(3)]
+
+        def cross(d, e):
+            return 0.25 * (
+                _gather3(flat, p + eye[d] + eye[e], shape)
+                - _gather3(flat, p + eye[d] - eye[e], shape)
+                - _gather3(flat, p - eye[d] + eye[e], shape)
+                + _gather3(flat, p - eye[d] - eye[e], shape))
+
+        # assemble by stacking (scatter-free; .at[:, d, e].set emits
+        # per-row HLO scatters)
+        hxy, hxz, hyz = cross(0, 1), cross(0, 2), cross(1, 2)
+        H = jnp.stack([
+            jnp.stack([diag[0], hxy, hxz], axis=-1),
+            jnp.stack([hxy, diag[1], hyz], axis=-1),
+            jnp.stack([hxz, hyz, diag[2]], axis=-1),
+        ], axis=-2)
         det = jnp.linalg.det(H)
         det_ok = jnp.abs(det) > 1e-12
         Hsafe = jnp.where(det_ok[:, None, None], H,
@@ -323,9 +337,13 @@ def dog_block_topk_impl(block, min_i, max_i, threshold, origin, sigma,
     dog, mask = dog_block(block, min_i, max_i, threshold, sigma,
                           find_max, find_min, origin)
     if halo > 0:
-        core = jnp.zeros(dog.shape, bool)
-        core = core.at[halo:dog.shape[0] - halo, halo:dog.shape[1] - halo,
-                       halo:dog.shape[2] - halo].set(True)
+        # broadcasted-iota comparisons, NOT a full-volume .at[].set — the
+        # latter lowers to an HLO scatter (a TPU serialization cliff)
+        core = None
+        for ax in range(3):
+            i = lax.broadcasted_iota(jnp.int32, dog.shape, ax)
+            m = (i >= halo) & (i < dog.shape[ax] - halo)
+            core = m if core is None else (core & m)
         mask = mask & core
     k = int(min(k, int(np.prod(dog.shape))))
     score = jnp.where(mask, jnp.abs(dog), -jnp.inf).ravel()
