@@ -194,9 +194,14 @@ def dog_block(
     # SparkInterestPointDetection.java:552-568) commutes with the DoG:
     # both blur kernels are normalized, so the constant offset cancels in
     # the difference and only the 1/(max-min) scale survives — folding it
-    # into the response scale saves two full-volume passes over the input
-    dog = diff * (1.0 / (DOG_K - 1.0)
-                  / jnp.maximum(max_intensity - min_intensity, 1e-20))
+    # into the response scale saves two full-volume passes over the input.
+    # Degenerate max<=min (flat view, data-derived bounds): the old
+    # normalization produced all-zero input => zero response; gate the
+    # scale to 0 so blur roundoff is not amplified into fake detections
+    inv_range = jnp.where(max_intensity > min_intensity,
+                          1.0 / jnp.maximum(max_intensity - min_intensity,
+                                            1e-20), 0.0)
+    dog = diff * ((1.0 / (DOG_K - 1.0)) * inv_range)
 
     if origin is None:
         origin = jnp.zeros(3, jnp.int32)

@@ -42,18 +42,38 @@ BST=${BST:-"python -m bigstitcher_spark_tpu.cli.main"}
 
 if [[ -z "$PID" ]]; then
   # local mode: all N processes on this machine against a local coordinator
-  COORD=${COORD:-"127.0.0.1:$(( 20000 + RANDOM % 20000 ))"}
+  # (free port picked by binding, not guessed)
+  if [[ -z "$COORD" ]]; then
+    PORT=$(python - <<'PY'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1])
+PY
+)
+    COORD="127.0.0.1:$PORT"
+  fi
   echo "[pod_launch] $NUM local processes, coordinator $COORD"
   pids=()
+  # a worker that dies leaves its peers blocked on the jax.distributed
+  # barrier forever — fail fast: first nonzero exit kills the rest
+  trap 'kill "${pids[@]}" 2>/dev/null' EXIT
   for i in $(seq 0 $((NUM - 1))); do
     BST_COORDINATOR="$COORD" BST_NUM_PROCESSES="$NUM" BST_PROCESS_ID="$i" \
       $BST "$@" > >(sed "s/^/[p$i] /") 2>&1 &
     pids+=($!)
   done
   rc=0
-  for p in "${pids[@]}"; do
-    wait "$p" || rc=$?
+  remaining=$NUM
+  while (( remaining > 0 )); do
+    if ! wait -n; then
+      rc=$?
+      echo "[pod_launch] a worker failed (rc=$rc); terminating the rest"
+      kill "${pids[@]}" 2>/dev/null
+      wait
+      exit "$rc"
+    fi
+    remaining=$((remaining - 1))
   done
+  trap - EXIT
   exit "$rc"
 fi
 

@@ -311,3 +311,19 @@ def test_blur_strategies_agree_on_core():
     h = dog_halo(s1)
     core = (slice(h, -h),) * 3
     np.testing.assert_allclose(fft[core], gemm[core], atol=2e-6)
+
+
+def test_flat_view_with_degenerate_bounds_detects_nothing():
+    """min_intensity == max_intensity (data-derived bounds on a blank or
+    saturated tile) must yield ZERO detections: the folded normalization
+    scale gates to 0 instead of amplifying blur roundoff by 1/1e-20
+    (r5 review finding)."""
+    import numpy as np
+
+    from bigstitcher_spark_tpu.ops.dog import dog_block
+
+    flat = np.full((32, 32, 32), 12345, np.uint16)
+    dog, mask = dog_block(flat, np.float32(12345), np.float32(12345),
+                          np.float32(0.008), 1.8)
+    assert int(np.asarray(mask).sum()) == 0
+    assert float(np.abs(np.asarray(dog)).max()) == 0.0

@@ -195,3 +195,51 @@ class TestRealTwoProcessRun:
             "ch0tp0/s0").read_full()
         assert ref_vol.std() > 0
         np.testing.assert_array_equal(ref_vol, multi_vol)
+
+
+class TestPodLaunchScript:
+    def test_local_mode_two_processes(self, tmp_path):
+        """scripts/pod_launch.sh -n 2 (local mode) must drive the fusion CLI
+        through a real 2-process jax.distributed run and exit 0."""
+        import os
+        import subprocess
+
+        from click.testing import CliRunner
+
+        from bigstitcher_spark_tpu.cli.main import cli
+        from bigstitcher_spark_tpu.io.chunkstore import ChunkStore
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(2, 1, 1), tile_size=(48, 48, 24),
+            overlap=16, jitter=0.0, n_beads_per_tile=10)
+        out = str(tmp_path / "fused.n5")
+        r = CliRunner().invoke(cli, [
+            "create-fusion-container", "-x", proj.xml_path, "-o", out,
+            "-s", "N5", "-d", "UINT16", "--blockSize", "24,24,24",
+            "--minIntensity", "0", "--maxIntensity", "65535",
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                    "XLA_FLAGS": ""})
+        # own session so a timeout can kill the whole process group (the
+        # workers are grandchildren of the bash wrapper)
+        proc = subprocess.Popen(
+            ["bash", os.path.join(repo, "scripts", "pod_launch.sh"),
+             "-n", "2", "--",
+             "affine-fusion", "-o", out, "--blockScale", "1,1,1"],
+            env=env, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, start_new_session=True)
+        try:
+            out_txt, _ = proc.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            import signal
+
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            raise
+        assert proc.returncode == 0, out_txt
+        vol = ChunkStore.open(out).open_dataset("ch0tp0/s0").read_full()
+        assert vol.std() > 0

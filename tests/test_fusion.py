@@ -430,3 +430,78 @@ class TestSeparableDiagonalKernel:
         blk = ds.read_full()
         np.testing.assert_allclose(vol, blk, atol=3e-3)
         assert vol.std() > 0
+
+
+class TestTpuLoweringSafety:
+    def test_composite_kernel_lowers_scatter_free(self, tmp_path):
+        """The composite fusion kernel must not emit HLO scatter ops:
+        .at[win].add on static windows lowers to scatter, which serializes
+        on TPU — the exact cliff r4's verdict flagged as untestable from
+        CPU runs. Pin the property at the HLO level so it cannot regress."""
+        import numpy as np
+
+        from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+        from bigstitcher_spark_tpu.models import affine_fusion as AF
+        from bigstitcher_spark_tpu.ops import fusion as F
+        from bigstitcher_spark_tpu.utils.testdata import (
+            make_synthetic_project,
+        )
+        from bigstitcher_spark_tpu.utils.viewselect import (
+            maximal_bounding_box,
+        )
+
+        proj = make_synthetic_project(
+            str(tmp_path / "p"), n_tiles=(2, 1, 1), tile_size=(32, 32, 16),
+            overlap=8, jitter=0.0, n_beads_per_tile=5)
+        sd = SpimData.load(proj.xml_path)
+        loader = ViewLoader(sd)
+        views = sd.view_ids()
+        bbox = maximal_bounding_box(sd, views)
+        cp = AF.plan_composite_volume(sd, loader, views, bbox, None,
+                                      AF.BlendParams())
+        assert cp is not None
+        tiles = AF.upload_composite_tiles(loader, cp)
+        for ftype in ("AVG_BLEND", "MAX_INTENSITY", "FIRST_WINS"):
+            fuser = F.make_translation_composite(
+                cp.out_shape, cp.windows, cp.n_offs, pad=cp.pad,
+                fusion_type=ftype, out_dtype="uint16", masks=False,
+                with_coeffs=False, kinds=cp.kinds)
+            low = fuser.lower(
+                tiles, cp.fracs, cp.img_dims, cp.borders, cp.ranges,
+                cp.inside_offs, np.float32(0), np.float32(65535),
+                cp.diags, cp.offs)
+            hlo = low.compiler_ir(dialect="hlo").as_hlo_text()
+            n_scatter = sum(1 for ln in hlo.splitlines()
+                            if " scatter(" in ln)
+            assert n_scatter == 0, (
+                f"{ftype}: composite kernel emits {n_scatter} scatter ops")
+
+    def test_dog_kernel_has_no_volume_scatter(self):
+        """The DoG detection kernel may keep tiny (K,3) index scatters from
+        the localizer, but no full-volume ones (the old core-mask
+        .at[].set)."""
+        import functools
+
+        import jax
+        import numpy as np
+
+        from bigstitcher_spark_tpu.ops import dog as D
+
+        fn = functools.partial(
+            jax.jit, static_argnames=("sigma", "find_max", "find_min", "k",
+                                      "halo", "rel"))(D.dog_block_topk_impl)
+        shape = (64, 64, 64)
+        low = fn.lower(np.zeros(shape, np.uint16), np.float32(0),
+                       np.float32(1), np.float32(0.008),
+                       np.zeros(3, np.int32), 1.8, True, False, 1024, 8,
+                       (1, 1, 1))
+        hlo = low.compiler_ir(dialect="hlo").as_hlo_text()
+        vol = int(np.prod(shape))
+        for ln in hlo.splitlines():
+            if " scatter(" not in ln:
+                continue
+            shape_txt = ln.split("=")[1].strip().split(" ")[0]
+            dims = shape_txt.split("[")[1].split("]")[0]
+            n = int(np.prod([int(x) for x in dims.split(",") if x]))
+            assert n < vol // 8, f"volume-sized scatter in DoG kernel: {ln[:120]}"
