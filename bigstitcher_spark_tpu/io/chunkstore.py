@@ -223,22 +223,17 @@ class Dataset:
 
         def read_one(pos):
             path = os.path.join(root, *[str(p) for p in pos])
-            blk = native_blockio.read_block(path, self.dtype, block,
-                                            compression=ctype)
             lo = [pos[d] * block[d] for d in range(ndim)]
-            if blk is None:  # absent chunk = fill (zeros)
+            src_lo = [max(off[d] - lo[d], 0) for d in range(ndim)]
+            dst_off = [max(lo[d] - off[d], 0) for d in range(ndim)]
+            copy = [min(off[d] + shp[d], lo[d] + block[d])
+                    - max(off[d], lo[d]) for d in range(ndim)]
+            if any(c <= 0 for c in copy):
                 return
-            src = tuple(
-                slice(max(off[d] - lo[d], 0),
-                      min(off[d] + shp[d] - lo[d], blk.shape[d]))
-                for d in range(ndim))
-            dst = tuple(
-                slice(max(lo[d] - off[d], 0),
-                      max(lo[d] - off[d], 0) + (src[d].stop - src[d].start))
-                for d in range(ndim))
-            if any(s.stop <= s.start for s in src):
-                return
-            out[dst] = blk[src]
+            # decode straight into the output box: the big-endian swap
+            # fuses with the strided write (absent chunk = fill zeros)
+            native_blockio.read_block_region(
+                path, out, dst_off, src_lo, copy, compression=ctype)
 
         positions = list(itertools.product(*grids))
         if len(positions) > 1:
@@ -281,11 +276,13 @@ class Dataset:
         ctype = comp.get("type", "zstd")
         from . import native_blockio
 
+        if not native_blockio.has_region_read():
+            # a stale libblockio.so predating the region reader must fall
+            # back to tensorstore cleanly, not crash inside _native_read
+            return None
         if ctype == "lz4":
             return "lz4" if native_blockio.has_lz4() else None
         if ctype not in ("zstd", "raw"):
-            return None
-        if not native_blockio.available():
             return None
         return ctype
 

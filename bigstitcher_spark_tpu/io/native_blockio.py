@@ -57,6 +57,14 @@ def _load():
         ctypes.c_int64, ctypes.POINTER(ctypes.c_uint32),
         ctypes.POINTER(ctypes.c_int32),
     ]
+    if hasattr(lib, "n5_read_block_region"):
+        lib.n5_read_block_region.restype = ctypes.c_int64
+        lib.n5_read_block_region.argtypes = [
+            ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int32),
+        ]
     if hasattr(lib, "lz4_available"):
         lib.lz4_available.restype = ctypes.c_int32
         lib.lz4_available.argtypes = []
@@ -75,6 +83,14 @@ def _load():
 def has_zarr() -> bool:
     lib = _load()
     return lib is not None and hasattr(lib, "zarr_write_chunk_file")
+
+
+def has_region_read() -> bool:
+    """True when the library exports the fused strided region reader
+    (n5_read_block_region) — older builds lack it and must use the
+    tensorstore path."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "n5_read_block_region")
 
 
 def has_lz4() -> bool:
@@ -144,6 +160,41 @@ def write_block(
     )
     if got < 0:
         raise IOError(f"n5_write_block_file({block_path}) failed: {got}")
+
+
+def read_block_region(
+    block_path: str,
+    dst: np.ndarray,
+    dst_offset: tuple[int, ...],
+    src_lo: tuple[int, ...],
+    copy_dims: tuple[int, ...],
+    compression: str = "zstd",
+) -> int | None:
+    """Decode one N5 block file and copy ``copy_dims`` voxels starting at
+    ``src_lo`` (in-chunk coords) directly into ``dst[dst_offset...]`` —
+    the big-endian swap fuses with the strided write (one pass; no
+    intermediate chunk array, no numpy assembly copy). Returns elements
+    copied, or None if the file is absent."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "n5_read_block_region"):
+        raise RuntimeError("native blockio region read not available")
+    ndim = dst.ndim
+    es = dst.dtype.itemsize
+    base = dst.ctypes.data + sum(
+        int(dst_offset[d]) * dst.strides[d] for d in range(ndim))
+    dstr = (ctypes.c_int64 * ndim)(*dst.strides)
+    lo = (ctypes.c_uint32 * ndim)(*[int(v) for v in src_lo])
+    cd = (ctypes.c_uint32 * ndim)(*[int(v) for v in copy_dims])
+    dims = (ctypes.c_uint32 * 16)()
+    nd = ctypes.c_int32()
+    got = lib.n5_read_block_region(
+        block_path.encode(), es, COMPRESSION[compression], ndim, lo, cd,
+        ctypes.c_void_p(base), dstr, dims, ctypes.byref(nd))
+    if got == -7:
+        return None
+    if got < 0:
+        raise IOError(f"n5_read_block_region({block_path}) failed: {got}")
+    return int(got)
 
 
 def read_block(

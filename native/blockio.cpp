@@ -517,6 +517,186 @@ int64_t zarr_write_chunk_file(const char* path, const uint8_t* data,
   return wrote == static_cast<int64_t>(got) ? wrote : -6;
 }
 
+namespace {
+
+// Shared N5 file read + header parse + decompress-to-contiguous-payload
+// (used by both whole-block and region readers). On success ``payload``
+// points into ``buf`` or ``tmp``; returns 0 or a negative error.
+int64_t n5_load_payload(const char* path, int32_t elem_size,
+                        int32_t compression, std::string& buf,
+                        std::string& tmp, const uint8_t** payload,
+                        uint32_t* dims_out, int32_t* ndim_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -7;
+  std::fseek(f, 0, SEEK_END);
+  const long len = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  buf.resize(static_cast<size_t>(len));
+  const size_t got = std::fread(&buf[0], 1, static_cast<size_t>(len), f);
+  std::fclose(f);
+  if (got != static_cast<size_t>(len)) return -6;
+  const uint8_t* enc = reinterpret_cast<const uint8_t*>(buf.data());
+  if (len < 4) return -1;
+  const uint16_t mode = get_u16_be(enc);
+  if (mode > 1) return -3;  // varlength mode unsupported
+  const int32_t ndim = get_u16_be(enc + 2);
+  if (ndim <= 0 || ndim > 16) return -1;
+  int64_t header = 4 + 4 * static_cast<int64_t>(ndim);
+  if (len < header) return -1;
+  int64_t n_elem = 1;
+  for (int32_t d = 0; d < ndim; ++d) {
+    dims_out[d] = get_u32_be(enc + 4 + 4 * d);
+    n_elem *= dims_out[d];
+  }
+  *ndim_out = ndim;
+  if (mode == 1) header += 4;
+  const size_t raw = static_cast<size_t>(n_elem) * elem_size;
+  if (compression == 0) {
+    if (len - header < static_cast<int64_t>(raw)) return -1;
+    *payload = enc + header;
+    return 0;
+  }
+  tmp.resize(raw);
+  if (compression == 2) {
+    const int64_t dgot = lz4block_decode(
+        enc + header, len - header, reinterpret_cast<uint8_t*>(&tmp[0]),
+        static_cast<int64_t>(raw));
+    if (dgot != static_cast<int64_t>(raw)) return dgot < 0 ? dgot : -2;
+  } else {
+    const size_t zgot = ZSTD_decompress(&tmp[0], raw, enc + header,
+                                        static_cast<size_t>(len - header));
+    if (ZSTD_isError(zgot) || zgot != raw) return -2;
+  }
+  *payload = reinterpret_cast<const uint8_t*>(tmp.data());
+  return 0;
+}
+
+}  // namespace
+
+// Read + decode one block file and copy a REGION of it directly into a
+// strided destination (the caller's output array), fusing the big-endian
+// swap with the strided write — one pass instead of decode + swap pass +
+// numpy strided-assembly pass. src_lo/copy_dims select the in-chunk region
+// (chunk dim order, first-axis-fastest); dst_strides are byte strides of
+// the destination for the same dims; ``expected_ndim`` guards the caller's
+// array sizes against corrupt/mismatched chunk headers. Returns elements
+// copied, <0 on error (-7: file missing, -10: ndim mismatch; 0 elements if
+// the stored chunk doesn't reach src_lo).
+int64_t n5_read_block_region(const char* path, int32_t elem_size,
+                             int32_t compression, int32_t expected_ndim,
+                             const uint32_t* src_lo,
+                             const uint32_t* copy_dims, uint8_t* dst,
+                             const int64_t* dst_strides, uint32_t* dims_out,
+                             int32_t* ndim_out) {
+  std::string buf, tmp;
+  const uint8_t* payload = nullptr;
+  const int64_t rc = n5_load_payload(path, elem_size, compression, buf, tmp,
+                                     &payload, dims_out, ndim_out);
+  if (rc < 0) return rc;
+  const int32_t ndim = *ndim_out;
+  if (ndim != expected_ndim || ndim > 8) return -10;
+  // clip the copy region against the STORED chunk dims (edge chunks may be
+  // smaller than the nominal block size)
+  uint32_t cdims[8];
+  int64_t total = 1;
+  for (int32_t d = 0; d < ndim; ++d) {
+    if (src_lo[d] >= dims_out[d]) return 0;
+    const uint32_t avail = dims_out[d] - src_lo[d];
+    cdims[d] = copy_dims[d] < avail ? copy_dims[d] : avail;
+    total *= cdims[d];
+  }
+  // source strides (F-order: first axis fastest), in bytes
+  int64_t sstr[8];
+  sstr[0] = elem_size;
+  for (int32_t d = 1; d < ndim; ++d)
+    sstr[d] = sstr[d - 1] * dims_out[d - 1];
+  int64_t src_base = 0;
+  for (int32_t d = 0; d < ndim; ++d)
+    src_base += static_cast<int64_t>(src_lo[d]) * sstr[d];
+  auto copy_swapped = [&](const uint8_t* sp, uint8_t* dp, int64_t sstep,
+                          int64_t dstep, int64_t n) {
+    switch (elem_size) {
+      case 1:
+        for (int64_t i = 0; i < n; ++i) dp[i * dstep] = sp[i * sstep];
+        break;
+      case 2:
+        for (int64_t i = 0; i < n; ++i) {
+          uint8_t* q = dp + i * dstep;
+          const uint8_t* s = sp + i * sstep;
+          q[0] = s[1];
+          q[1] = s[0];
+        }
+        break;
+      case 4:
+        for (int64_t i = 0; i < n; ++i) {
+          uint8_t* q = dp + i * dstep;
+          const uint8_t* s = sp + i * sstep;
+          q[0] = s[3];
+          q[1] = s[2];
+          q[2] = s[1];
+          q[3] = s[0];
+        }
+        break;
+      default:
+        for (int64_t i = 0; i < n; ++i) {
+          uint8_t* q = dp + i * dstep;
+          const uint8_t* s = sp + i * sstep;
+          for (int b = 0; b < elem_size; ++b) q[b] = s[elem_size - 1 - b];
+        }
+    }
+  };
+
+  if (ndim == 3) {
+    // 3-D fast path with cache tiling: axis 0 is source-dense, one of the
+    // other axes is usually destination-dense (C-order outputs) — tile the
+    // (0, dst-dense) plane so both sides' cache lines are reused instead of
+    // one side missing on every element
+    const int32_t zd = dst_strides[2] <= dst_strides[1] ? 2 : 1;
+    const int32_t yd = zd == 2 ? 1 : 2;
+    const int64_t T = 64;
+    for (uint32_t y = 0; y < cdims[yd]; ++y) {
+      for (uint32_t x0 = 0; x0 < cdims[0]; x0 += T) {
+        const int64_t nx =
+            (cdims[0] - x0) < T ? (cdims[0] - x0) : T;
+        for (uint32_t z0 = 0; z0 < cdims[zd]; z0 += T) {
+          const int64_t nz =
+              (cdims[zd] - z0) < T ? (cdims[zd] - z0) : T;
+          for (int64_t x = 0; x < nx; ++x) {
+            const int64_t so = src_base + (x0 + x) * sstr[0] +
+                               static_cast<int64_t>(y) * sstr[yd] +
+                               static_cast<int64_t>(z0) * sstr[zd];
+            const int64_t dofs = (x0 + x) * dst_strides[0] +
+                                 static_cast<int64_t>(y) * dst_strides[yd] +
+                                 static_cast<int64_t>(z0) * dst_strides[zd];
+            copy_swapped(payload + so, dst + dofs, sstr[zd],
+                         dst_strides[zd], nz);
+          }
+        }
+      }
+    }
+    return total;
+  }
+
+  // generic odometer (ndim != 3): inner loop walks the source-dense axis 0
+  uint32_t idx[8] = {0};
+  const int64_t inner = cdims[0];
+  for (;;) {
+    int64_t so = src_base, dofs = 0;
+    for (int32_t d = 1; d < ndim; ++d) {
+      so += static_cast<int64_t>(idx[d]) * sstr[d];
+      dofs += static_cast<int64_t>(idx[d]) * dst_strides[d];
+    }
+    copy_swapped(payload + so, dst + dofs, sstr[0], dst_strides[0], inner);
+    int32_t d = 1;
+    for (; d < ndim; ++d) {
+      if (++idx[d] < cdims[d]) break;
+      idx[d] = 0;
+    }
+    if (d >= ndim) break;
+  }
+  return total;
+}
+
 // Read + decode one block file. Returns elements decoded, <0 on error
 // (-7: file missing).
 int64_t n5_read_block_file(const char* path, int32_t elem_size,

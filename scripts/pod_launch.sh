@@ -61,20 +61,22 @@ PY
       $BST "$@" > >(sed "s/^/[p$i] /") 2>&1 &
     pids+=($!)
   done
-  rc=0
   remaining=$NUM
   while (( remaining > 0 )); do
-    if ! wait -n; then
-      rc=$?
+    set +e
+    wait -n
+    rc=$?
+    set -e
+    if (( rc != 0 )); then
       echo "[pod_launch] a worker failed (rc=$rc); terminating the rest"
       kill "${pids[@]}" 2>/dev/null
-      wait
+      wait || true
       exit "$rc"
     fi
     remaining=$((remaining - 1))
   done
   trap - EXIT
-  exit "$rc"
+  exit 0
 fi
 
 [[ -n "$COORD" ]] || { echo "-c coordinator required with -i"; exit 2; }
