@@ -221,6 +221,8 @@ class Dataset:
                  for d in range(ndim)]
         import itertools
 
+        fused = native_blockio.has_region_read()
+
         def read_one(pos):
             path = os.path.join(root, *[str(p) for p in pos])
             lo = [pos[d] * block[d] for d in range(ndim)]
@@ -230,10 +232,27 @@ class Dataset:
                     - max(off[d], lo[d]) for d in range(ndim)]
             if any(c <= 0 for c in copy):
                 return
-            # decode straight into the output box: the big-endian swap
-            # fuses with the strided write (absent chunk = fill zeros)
-            native_blockio.read_block_region(
-                path, out, dst_off, src_lo, copy, compression=ctype)
+            if fused:
+                # decode straight into the output box: the big-endian swap
+                # fuses with the strided write (absent chunk = fill zeros)
+                native_blockio.read_block_region(
+                    path, out, dst_off, src_lo, copy, compression=ctype)
+                return
+            # stale libblockio.so without the region symbol: decode the
+            # whole chunk and assemble in numpy (keeps lz4 readable)
+            blk = native_blockio.read_block(path, self.dtype, block,
+                                            compression=ctype)
+            if blk is None:
+                return
+            src = tuple(
+                slice(src_lo[d], min(src_lo[d] + copy[d], blk.shape[d]))
+                for d in range(ndim))
+            if any(s.stop <= s.start for s in src):
+                return
+            dst = tuple(
+                slice(dst_off[d], dst_off[d] + (src[d].stop - src[d].start))
+                for d in range(ndim))
+            out[dst] = blk[src]
 
         positions = list(itertools.product(*grids))
         if len(positions) > 1:
@@ -276,13 +295,11 @@ class Dataset:
         ctype = comp.get("type", "zstd")
         from . import native_blockio
 
-        if not native_blockio.has_region_read():
-            # a stale libblockio.so predating the region reader must fall
-            # back to tensorstore cleanly, not crash inside _native_read
-            return None
         if ctype == "lz4":
             return "lz4" if native_blockio.has_lz4() else None
         if ctype not in ("zstd", "raw"):
+            return None
+        if not native_blockio.available():
             return None
         return ctype
 

@@ -281,6 +281,70 @@ bool mkdirs_for(const std::string& file_path) {
   return true;
 }
 
+
+
+// Shared N5 header parse + decompress-to-contiguous-payload over an
+// in-memory buffer (used by n5_decode_block AND the file readers). On
+// success ``payload`` points into ``enc`` or ``tmp``; returns 0 or a
+// negative error.
+int64_t n5_parse_payload(const uint8_t* enc, int64_t len, int32_t elem_size,
+                         int32_t compression, std::string& tmp,
+                         const uint8_t** payload, uint32_t* dims_out,
+                         int32_t* ndim_out) {
+  if (len < 4) return -1;
+  const uint16_t mode = get_u16_be(enc);
+  if (mode > 1) return -3;  // varlength mode unsupported
+  const int32_t ndim = get_u16_be(enc + 2);
+  if (ndim <= 0 || ndim > 16) return -1;
+  int64_t header = 4 + 4 * static_cast<int64_t>(ndim);
+  if (mode == 1) header += 4;  // u32 actual element count (varmode)
+  if (len < header) return -1;  // checked AFTER the varmode extension
+  int64_t n_elem = 1;
+  for (int32_t d = 0; d < ndim; ++d) {
+    dims_out[d] = get_u32_be(enc + 4 + 4 * d);
+    n_elem *= dims_out[d];
+  }
+  *ndim_out = ndim;
+  const size_t raw = static_cast<size_t>(n_elem) * elem_size;
+  if (compression == 0) {
+    if (len - header < static_cast<int64_t>(raw)) return -1;
+    *payload = enc + header;
+    return 0;
+  }
+  tmp.resize(raw);
+  if (compression == 2) {
+    const int64_t dgot = lz4block_decode(
+        enc + header, len - header, reinterpret_cast<uint8_t*>(&tmp[0]),
+        static_cast<int64_t>(raw));
+    if (dgot != static_cast<int64_t>(raw)) return dgot < 0 ? dgot : -2;
+  } else {
+    const size_t zgot = ZSTD_decompress(&tmp[0], raw, enc + header,
+                                        static_cast<size_t>(len - header));
+    if (ZSTD_isError(zgot) || zgot != raw) return -2;
+  }
+  *payload = reinterpret_cast<const uint8_t*>(tmp.data());
+  return 0;
+}
+
+// File read + shared parse.
+int64_t n5_load_payload(const char* path, int32_t elem_size,
+                        int32_t compression, std::string& buf,
+                        std::string& tmp, const uint8_t** payload,
+                        uint32_t* dims_out, int32_t* ndim_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -7;
+  std::fseek(f, 0, SEEK_END);
+  const long len = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  buf.resize(static_cast<size_t>(len));
+  const size_t got = std::fread(&buf[0], 1, static_cast<size_t>(len), f);
+  std::fclose(f);
+  if (got != static_cast<size_t>(len)) return -6;
+  return n5_parse_payload(reinterpret_cast<const uint8_t*>(buf.data()), len,
+                          elem_size, compression, tmp, payload, dims_out,
+                          ndim_out);
+}
+
 }  // namespace
 
 extern "C" {
@@ -343,43 +407,15 @@ int64_t n5_encode_block(const uint8_t* data, int32_t elem_size,
 int64_t n5_decode_block(const uint8_t* enc, int64_t enc_len, int32_t elem_size,
                         int32_t compression, uint8_t* out, int64_t out_cap,
                         uint32_t* dims_out, int32_t* ndim_out) {
-  if (enc_len < 4) return -1;
-  const uint16_t mode = get_u16_be(enc);
-  if (mode > 1) return -3;  // varlength mode unsupported
-  const int32_t ndim = get_u16_be(enc + 2);
-  if (ndim <= 0 || ndim > 16) return -1;
-  int64_t header = 4 + 4 * static_cast<int64_t>(ndim);
-  if (enc_len < header) return -1;
+  std::string tmp;
+  const uint8_t* payload = nullptr;
+  const int64_t rc = n5_parse_payload(enc, enc_len, elem_size, compression,
+                                      tmp, &payload, dims_out, ndim_out);
+  if (rc < 0) return rc;
   int64_t n_elem = 1;
-  for (int32_t d = 0; d < ndim; ++d) {
-    dims_out[d] = get_u32_be(enc + 4 + 4 * d);
-    n_elem *= dims_out[d];
-  }
-  *ndim_out = ndim;
-  if (mode == 1) header += 4;  // u32 actual element count (varmode)
+  for (int32_t d = 0; d < *ndim_out; ++d) n_elem *= dims_out[d];
   const size_t raw = static_cast<size_t>(n_elem) * elem_size;
   if (out_cap < static_cast<int64_t>(raw)) return -1;
-
-  std::string tmp;
-  const uint8_t* payload;
-  if (compression == 0) {
-    if (enc_len - header < static_cast<int64_t>(raw)) return -1;
-    payload = enc + header;
-  } else if (compression == 2) {
-    tmp.resize(raw);
-    const int64_t got = lz4block_decode(enc + header, enc_len - header,
-                                        reinterpret_cast<uint8_t*>(&tmp[0]),
-                                        static_cast<int64_t>(raw));
-    if (got != static_cast<int64_t>(raw)) return got < 0 ? got : -2;
-    payload = reinterpret_cast<const uint8_t*>(tmp.data());
-  } else {
-    tmp.resize(raw);
-    const size_t got =
-        ZSTD_decompress(&tmp[0], raw, enc + header,
-                        static_cast<size_t>(enc_len - header));
-    if (ZSTD_isError(got) || got != raw) return -2;
-    payload = reinterpret_cast<const uint8_t*>(tmp.data());
-  }
   if (elem_size > 1) {
     swap_bytes(payload, out, static_cast<size_t>(n_elem), elem_size);
   } else {
@@ -441,59 +477,104 @@ int64_t zarr_write_chunk_file(const char* path, const uint8_t* data,
         std::memcpy(out + i * elem_size, fill, elem_size);
     }
   }
-  // odometer over all but the innermost dim; memcpy contiguous inner runs
-  // when the innermost stride is dense, else element-wise
+  // assembly into disk (C) order. The caller passes a transposed VIEW, so
+  // the source-dense axis is usually NOT the chunk-dense (last) axis —
+  // tile the (src-dense, dst-dense) plane so both sides' cache lines are
+  // reused (the untiled walk paid a miss per element on 3-D fusion slabs).
   int64_t chunk_stride[8];
   chunk_stride[ndim - 1] = elem_size;
   for (int32_t d = ndim - 2; d >= 0; --d)
     chunk_stride[d] = chunk_stride[d + 1] * chunk_dims[d + 1];
-  const bool dense_inner = strides[ndim - 1] == elem_size;
+
+  auto copy_run = [&](const uint8_t* sp, uint8_t* dp, int64_t sstep,
+                      int64_t dstep, int64_t n) {
+    if (sstep == elem_size && dstep == elem_size) {
+      std::memcpy(dp, sp, static_cast<size_t>(n) * elem_size);
+      return;
+    }
+    switch (elem_size) {  // constant-size memcpy folds to one load/store
+      case 1:
+        for (int64_t i = 0; i < n; ++i) dp[i * dstep] = sp[i * sstep];
+        break;
+      case 2:
+        for (int64_t i = 0; i < n; ++i)
+          std::memcpy(dp + i * dstep, sp + i * sstep, 2);
+        break;
+      case 4:
+        for (int64_t i = 0; i < n; ++i)
+          std::memcpy(dp + i * dstep, sp + i * sstep, 4);
+        break;
+      case 8:
+        for (int64_t i = 0; i < n; ++i)
+          std::memcpy(dp + i * dstep, sp + i * sstep, 8);
+        break;
+      default:
+        for (int64_t i = 0; i < n; ++i)
+          std::memcpy(dp + i * dstep, sp + i * sstep, elem_size);
+    }
+  };
+
+  // source-dense axis (smallest stride among size>1 axes)
+  int32_t sa = ndim - 1;
+  for (int32_t d = 0; d < ndim; ++d) {
+    if (src_dims[d] > 1 &&
+        (src_dims[sa] <= 1 ||
+         std::llabs(strides[d]) < std::llabs(strides[sa])))
+      sa = d;
+  }
+  const int32_t db = ndim - 1;  // chunk-dense axis (C order)
+  const int64_t T = 64;
   uint32_t idx[8] = {0};
-  const int64_t inner = src_dims[ndim - 1];
-  for (;;) {
-    int64_t src_off = 0, dst_off = 0;
-    for (int32_t d = 0; d < ndim - 1; ++d) {
-      src_off += static_cast<int64_t>(idx[d]) * strides[d];
-      dst_off += static_cast<int64_t>(idx[d]) * chunk_stride[d];
-    }
-    if (dense_inner) {
-      std::memcpy(out + dst_off, data + src_off,
-                  static_cast<size_t>(inner) * elem_size);
-    } else {
-      // strided inner run (transposed views): constant-size memcpy per
-      // element beats a runtime-size memcpy call by ~5x (measured on the
-      // fusion drain) — the compiler folds each to a single load/store,
-      // and unlike typed pointer casts it is alignment/aliasing-safe
-      const int64_t istr = strides[ndim - 1];
-      switch (elem_size) {
-        case 1:
-          for (int64_t i = 0; i < inner; ++i)
-            out[dst_off + i] = data[src_off + i * istr];
-          break;
-        case 2:
-          for (int64_t i = 0; i < inner; ++i)
-            std::memcpy(out + dst_off + 2 * i, data + src_off + i * istr, 2);
-          break;
-        case 4:
-          for (int64_t i = 0; i < inner; ++i)
-            std::memcpy(out + dst_off + 4 * i, data + src_off + i * istr, 4);
-          break;
-        case 8:
-          for (int64_t i = 0; i < inner; ++i)
-            std::memcpy(out + dst_off + 8 * i, data + src_off + i * istr, 8);
-          break;
-        default:
-          for (int64_t i = 0; i < inner; ++i)
-            std::memcpy(out + dst_off + i * elem_size,
-                        data + src_off + i * istr, elem_size);
+  if (sa != db && src_dims[sa] > 1 && src_dims[db] > 1) {
+    // odometer over all axes except sa/db; tiled (sa, db) copies inside
+    for (;;) {
+      int64_t src_off = 0, dst_off = 0;
+      for (int32_t d = 0; d < ndim; ++d) {
+        if (d == sa || d == db) continue;
+        src_off += static_cast<int64_t>(idx[d]) * strides[d];
+        dst_off += static_cast<int64_t>(idx[d]) * chunk_stride[d];
       }
+      const int64_t na = src_dims[sa], nb = src_dims[db];
+      for (int64_t a0 = 0; a0 < na; a0 += T) {
+        const int64_t ta = (na - a0) < T ? (na - a0) : T;
+        for (int64_t b0 = 0; b0 < nb; b0 += T) {
+          const int64_t tb = (nb - b0) < T ? (nb - b0) : T;
+          for (int64_t b = 0; b < tb; ++b) {
+            const int64_t so = src_off + a0 * strides[sa] +
+                               (b0 + b) * strides[db];
+            const int64_t dofs = dst_off + a0 * chunk_stride[sa] +
+                                 (b0 + b) * chunk_stride[db];
+            copy_run(data + so, out + dofs, strides[sa], chunk_stride[sa],
+                     ta);
+          }
+        }
+      }
+      int32_t d = ndim - 1;
+      for (; d >= 0; --d) {
+        if (d == sa || d == db) continue;
+        if (++idx[d] < src_dims[d]) break;
+        idx[d] = 0;
+      }
+      if (d < 0) break;
     }
-    int32_t d = ndim - 2;
-    for (; d >= 0; --d) {
-      if (++idx[d] < src_dims[d]) break;
-      idx[d] = 0;
+  } else {
+    // source-dense == chunk-dense (or degenerate): plain inner runs
+    const int64_t inner = src_dims[ndim - 1];
+    for (;;) {
+      int64_t src_off = 0, dst_off = 0;
+      for (int32_t d = 0; d < ndim - 1; ++d) {
+        src_off += static_cast<int64_t>(idx[d]) * strides[d];
+        dst_off += static_cast<int64_t>(idx[d]) * chunk_stride[d];
+      }
+      copy_run(data + src_off, out + dst_off, strides[ndim - 1], elem_size,
+               inner);
+      int32_t d = ndim - 2;
+      for (; d >= 0; --d) {
+        if (++idx[d] < src_dims[d]) break;
+        idx[d] = 0;
+      }
+      if (d < 0) break;
     }
-    if (d < 0) break;
   }
   std::string p(path);
   if (!mkdirs_for(p)) return -4;
@@ -517,61 +598,6 @@ int64_t zarr_write_chunk_file(const char* path, const uint8_t* data,
   return wrote == static_cast<int64_t>(got) ? wrote : -6;
 }
 
-namespace {
-
-// Shared N5 file read + header parse + decompress-to-contiguous-payload
-// (used by both whole-block and region readers). On success ``payload``
-// points into ``buf`` or ``tmp``; returns 0 or a negative error.
-int64_t n5_load_payload(const char* path, int32_t elem_size,
-                        int32_t compression, std::string& buf,
-                        std::string& tmp, const uint8_t** payload,
-                        uint32_t* dims_out, int32_t* ndim_out) {
-  FILE* f = std::fopen(path, "rb");
-  if (!f) return -7;
-  std::fseek(f, 0, SEEK_END);
-  const long len = std::ftell(f);
-  std::fseek(f, 0, SEEK_SET);
-  buf.resize(static_cast<size_t>(len));
-  const size_t got = std::fread(&buf[0], 1, static_cast<size_t>(len), f);
-  std::fclose(f);
-  if (got != static_cast<size_t>(len)) return -6;
-  const uint8_t* enc = reinterpret_cast<const uint8_t*>(buf.data());
-  if (len < 4) return -1;
-  const uint16_t mode = get_u16_be(enc);
-  if (mode > 1) return -3;  // varlength mode unsupported
-  const int32_t ndim = get_u16_be(enc + 2);
-  if (ndim <= 0 || ndim > 16) return -1;
-  int64_t header = 4 + 4 * static_cast<int64_t>(ndim);
-  if (len < header) return -1;
-  int64_t n_elem = 1;
-  for (int32_t d = 0; d < ndim; ++d) {
-    dims_out[d] = get_u32_be(enc + 4 + 4 * d);
-    n_elem *= dims_out[d];
-  }
-  *ndim_out = ndim;
-  if (mode == 1) header += 4;
-  const size_t raw = static_cast<size_t>(n_elem) * elem_size;
-  if (compression == 0) {
-    if (len - header < static_cast<int64_t>(raw)) return -1;
-    *payload = enc + header;
-    return 0;
-  }
-  tmp.resize(raw);
-  if (compression == 2) {
-    const int64_t dgot = lz4block_decode(
-        enc + header, len - header, reinterpret_cast<uint8_t*>(&tmp[0]),
-        static_cast<int64_t>(raw));
-    if (dgot != static_cast<int64_t>(raw)) return dgot < 0 ? dgot : -2;
-  } else {
-    const size_t zgot = ZSTD_decompress(&tmp[0], raw, enc + header,
-                                        static_cast<size_t>(len - header));
-    if (ZSTD_isError(zgot) || zgot != raw) return -2;
-  }
-  *payload = reinterpret_cast<const uint8_t*>(tmp.data());
-  return 0;
-}
-
-}  // namespace
 
 // Read + decode one block file and copy a REGION of it directly into a
 // strided destination (the caller's output array), fusing the big-endian

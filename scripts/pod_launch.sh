@@ -69,7 +69,7 @@ PY
     set -e
     if (( rc != 0 )); then
       echo "[pod_launch] a worker failed (rc=$rc); terminating the rest"
-      kill "${pids[@]}" 2>/dev/null
+      kill "${pids[@]}" 2>/dev/null || true
       wait || true
       exit "$rc"
     fi
