@@ -319,6 +319,35 @@ def measure_phasecorr_baseline(jobs):
     return len(jobs) / dt
 
 
+def _spans_snapshot():
+    from bigstitcher_spark_tpu import profiling
+
+    return {k: {"count": s.count, "total_s": round(s.total_s, 3),
+                "max_s": round(s.max_s, 3)}
+            for k, s in profiling.get().stats().items()}
+
+
+def _best_timed(n, fn):
+    """Run ``fn`` n times under span profiling; return (best_dt, result,
+    spans) of the fastest run (same span schema as the fusion measure).
+    Profiling is always disabled on exit, even if ``fn`` raises."""
+    from bigstitcher_spark_tpu import profiling
+
+    best_dt, best_res, spans = float("inf"), None, {}
+    try:
+        for _ in range(n):
+            profiling.enable(True)
+            profiling.get().reset()
+            t0 = time.time()
+            res = fn()
+            dt = time.time() - t0
+            if dt < best_dt:
+                best_dt, best_res, spans = dt, res, _spans_snapshot()
+    finally:
+        profiling.enable(False)
+    return best_dt, best_res, spans
+
+
 def _stitch_jobs(xml_path):
     from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
     from bigstitcher_spark_tpu.io.spimdata import SpimData
@@ -349,11 +378,8 @@ def measure_phasecorr(xml_path):
     sd, jobs, params = _stitch_jobs(xml_path)
 
     stitch_jobs(sd, jobs, params)  # compile
-    dt = float("inf")
-    for _ in range(3):  # best-of-3, matching the baseline's treatment
-        t0 = time.time()
-        results = stitch_jobs(sd, jobs, params)
-        dt = min(dt, time.time() - t0)
+    # best-of-3, matching the baseline's treatment
+    dt, results, spans = _best_timed(3, lambda: stitch_jobs(sd, jobs, params))
     cpu = measure_phasecorr_baseline(jobs)
     return {
         "metric": "phasecorr_pairs_per_sec",
@@ -362,6 +388,7 @@ def measure_phasecorr(xml_path):
         "pairs": len(results),
         "vs_baseline": round(len(results) / dt / cpu, 3),
         "baseline_pairs_per_sec": round(cpu, 3),
+        "spans": spans,
     }
 
 
@@ -468,12 +495,10 @@ def measure_dog(xml_path):
         int(np.prod(_ViewPlan(loader, v, params.downsampling).det_dims))
         for v in views)
     detect_interest_points(sd, loader, views, params, progress=False)  # warm
-    dt = float("inf")
-    for _ in range(3):  # best-of-3, matching the baseline's treatment
-        t0 = time.time()
-        dets = detect_interest_points(sd, loader, views, params,
-                                      progress=False)
-        dt = min(dt, time.time() - t0)
+    # best-of-3, matching the baseline's treatment
+    dt, dets, spans = _best_timed(
+        3, lambda: detect_interest_points(sd, loader, views, params,
+                                          progress=False))
     cpu = measure_dog_baseline(xml_path)
     n_spots = sum(len(d.points) for d in dets)
     return {
@@ -483,6 +508,7 @@ def measure_dog(xml_path):
         "spots": int(n_spots),
         "vs_baseline": round(total_vox / dt / cpu, 3),
         "baseline_vox_per_sec": round(cpu, 1),
+        "spans": spans,
     }
 
 
@@ -844,20 +870,19 @@ def child_main():
     _log("warmup fusion done")
     best = None
     best_spans = {}
-    for i in range(FUSION_RUNS):
-        profiling.enable(True)
-        profiling.get().reset()
-        stats, ds, bbox = run_fusion(xml, out)
-        v = stats.voxels / max(stats.seconds, 1e-9)
-        _log(f"fusion run {i + 1}/{FUSION_RUNS}: {v:,.0f} vox/s "
-             f"({stats.seconds:.2f}s)")
-        if best is None or v > best[0]:
-            best = (v, stats, ds)
-            best_spans = {
-                k: {"count": s.count, "total_s": round(s.total_s, 3),
-                    "max_s": round(s.max_s, 3)}
-                for k, s in profiling.get().stats().items()}
-    profiling.enable(False)
+    try:
+        for i in range(FUSION_RUNS):
+            profiling.enable(True)
+            profiling.get().reset()
+            stats, ds, bbox = run_fusion(xml, out)
+            v = stats.voxels / max(stats.seconds, 1e-9)
+            _log(f"fusion run {i + 1}/{FUSION_RUNS}: {v:,.0f} vox/s "
+                 f"({stats.seconds:.2f}s)")
+            if best is None or v > best[0]:
+                best = (v, stats, ds)
+                best_spans = _spans_snapshot()
+    finally:
+        profiling.enable(False)
     vox_per_sec, stats, ds = best
     # validate: the XLA output must agree with the baseline implementation
     # (same math, independent code path) on the first block
