@@ -300,3 +300,49 @@ def map_setup_ids_cmd(xml, dry_run, xml_out, rows, columns, parallel_rows):
         raise click.ClickException(str(e)) from e
     sd.save(xml_out or xml)
     click.echo(f"remapped {len(mapping)} setups -> {xml_out or xml}")
+
+
+@click.command()
+def env_cmd():
+    """Print runtime diagnostics: devices, native codec, storage config
+    (the role of the reference's Spark/executor-identity printouts,
+    util/Spark.java:235-238 / cloud/TestCloudFunctions.java)."""
+    import jax
+
+    import bigstitcher_spark_tpu
+    from ..io import native_blockio, uris
+    from ..parallel.distributed import world
+
+    click.echo(f"bigstitcher_spark_tpu {getattr(bigstitcher_spark_tpu, '__version__', 'dev')}")
+    click.echo(f"jax {jax.__version__}")
+    try:
+        devs = jax.local_devices()
+        pi, pc = world()
+        click.echo(f"backend: {jax.default_backend()}; "
+                   f"{len(devs)} local device(s): "
+                   f"{', '.join(str(d) for d in devs)}")
+        click.echo(f"process {pi} of {pc}"
+                   + (" (multi-host runtime active)" if pc > 1 else ""))
+    except Exception as e:  # a dead accelerator tunnel must not hide the rest
+        click.echo(f"backend: UNAVAILABLE ({e})")
+    import tensorstore as ts
+
+    ts_ver = getattr(ts, "__version__", None)
+    click.echo(f"tensorstore {ts_ver or '(version attribute unavailable)'}")
+    if native_blockio.available():
+        click.echo(
+            "native codec: available"
+            + (", zarr" if native_blockio.has_zarr() else "")
+            + (", lz4" if native_blockio.has_lz4() else ", no-lz4")
+            + (", fused-region-read" if native_blockio.has_region_read()
+               else ", whole-block-read"))
+    else:
+        click.echo("native codec: NOT built (make -C native; "
+                   "tensorstore fallback active, lz4 N5 unreadable)")
+    import os
+
+    click.echo(f"BST_NATIVE_IO={os.environ.get('BST_NATIVE_IO', '1')}")
+    if uris.get_s3_region():
+        click.echo(f"s3 region: {uris.get_s3_region()}")
+    if uris.get_s3_endpoint():
+        click.echo(f"s3 endpoint: {uris.get_s3_endpoint()}")

@@ -186,3 +186,15 @@ def test_cli_split(project, tmp_path):
     sd = SpimData.load(out_xml)
     assert len(sd.setups) == 8
     assert len(sd.split_info) == 8
+
+
+def test_env_diagnostics_command():
+    """`bst env` prints runtime diagnostics without touching any project."""
+    from click.testing import CliRunner
+
+    from bigstitcher_spark_tpu.cli.main import cli
+
+    r = CliRunner().invoke(cli, ["env"], catch_exceptions=False)
+    assert r.exit_code == 0, r.output
+    assert "native codec:" in r.output
+    assert "backend:" in r.output
