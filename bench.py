@@ -43,6 +43,8 @@ FIXTURE_SPEC = {
 }
 CHILD_TIMEOUT_S = int(os.environ.get("BST_BENCH_CHILD_TIMEOUT", 1500))
 TPU_ATTEMPTS = 2
+# same-process baseline memo (one measurement per bench child)
+_RUN_BASELINES: dict = {}
 # best-of-N: wall-clock noise on a shared host (and tunnel weather on TPU)
 # swings single runs ~30%; five runs stabilize the headline artifact
 FUSION_RUNS = int(os.environ.get("BST_BENCH_RUNS", 5))
@@ -398,6 +400,72 @@ def measure_phasecorr(xml_path):
     }
 
 
+def measure_phasecorr_kernel(xml_path):
+    """Device-resident phase correlation: the production PCM program
+    (rfftn x2, normalized cross-power, irfftn, wrapped separable local-max,
+    top-P peak extraction — ops/phasecorr.pcm_peaks_batch, the same program
+    ``stitch_jobs`` dispatches) timed with the padded pair stacks already
+    in HBM and only the small peak tables leaving the device. End-to-end
+    stitching through the axon tunnel pays crop h2d on a shared wire; this
+    isolates the framework's device compute rate (counterpart of
+    affine_fusion_kernel_voxels_per_sec for the stitching stage). The
+    baseline pairs/s is the full CPU pipeline (FFTs + Pearson refinement);
+    the note records that the device program excludes the host refinement
+    tail, which measure_phasecorr prices in."""
+    import numpy as np
+
+    import jax
+
+    from bigstitcher_spark_tpu.models.stitching import _fft_shape
+    from bigstitcher_spark_tpu.ops.phasecorr import pad_to, pcm_peaks_batch
+
+    sd, jobs, params = _stitch_jobs(xml_path)
+    buckets: dict[tuple, list] = {}
+    for j in jobs:
+        shp = tuple(_fft_shape(np.maximum(j.crop_a.shape, j.crop_b.shape)))
+        buckets.setdefault(shp, []).append(j)
+    shp, bjobs = max(buckets.items(), key=lambda kv: len(kv[1]))
+    a = jax.device_put(np.stack([pad_to(j.crop_a, shp) for j in bjobs]))
+    b = jax.device_put(np.stack([pad_to(j.crop_b, shp) for j in bjobs]))
+    ea = jax.device_put(
+        np.stack([np.array(j.crop_a.shape, np.int32) for j in bjobs]))
+    eb = jax.device_put(
+        np.stack([np.array(j.crop_b.shape, np.int32) for j in bjobs]))
+    jax.block_until_ready(
+        pcm_peaks_batch(a, b, ea, eb, params.peaks_to_check, 0.25))
+    reps = 20
+    t0 = time.time()
+    for _ in range(reps):
+        peaks = pcm_peaks_batch(a, b, ea, eb, params.peaks_to_check, 0.25)
+        jax.block_until_ready(peaks)
+    per_rep = (time.time() - t0) / reps
+    # CPU baseline over the SAME pair subset (buckets have different
+    # orientations/costs, so the all-pairs baseline is a different
+    # workload); measured inline so the all-pairs cache entry stays clean
+    _np_phasecorr_pair(bjobs[0].crop_a, bjobs[0].crop_b)  # warm
+    cpu_dt = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        for j in bjobs:
+            _np_phasecorr_pair(j.crop_a, j.crop_b)
+        cpu_dt = min(cpu_dt, time.time() - t0)
+    cpu = len(bjobs) / cpu_dt
+    value = len(bjobs) / per_rep
+    return {
+        "metric": "phasecorr_kernel_pairs_per_sec",
+        "value": round(value, 3),
+        "unit": "pair/s",
+        "pairs": len(bjobs),
+        "fft_shape": list(shp),
+        "vs_baseline": round(value / cpu, 3),
+        "baseline_pairs_per_sec": round(cpu, 3),
+        "note": ("pair stacks in HBM, dispatch+compute only, largest FFT "
+                 "bucket; baseline is the full CPU pipeline incl. host "
+                 "Pearson refinement over the SAME pairs (all pairs priced "
+                 "end-to-end by phasecorr_pairs_per_sec)"),
+    }
+
+
 def measure_dog_baseline(xml_path):
     """CPU DoG detection vox/sec: scipy gaussian blurs, subtraction,
     3^3 local maxima, threshold, quadratic subpixel fit. Intensity bounds
@@ -406,6 +474,12 @@ def measure_dog_baseline(xml_path):
     (SparkInterestPointDetection.java:140-144)."""
     import numpy as np
 
+    # one measurement per process: measure_dog AND measure_dog_kernel both
+    # need this number; re-measuring would burn ~3 full-volume CPU passes
+    # and rotate the cache's previous_vox_per_sec cross-run history onto a
+    # same-run intermediate
+    if "dog" in _RUN_BASELINES:
+        return _RUN_BASELINES["dog"]
     cache = _baseline_cache_load()
     key = _fixture_key("dog-explicit-minmax")
     ent = cache.get("dog")
@@ -481,7 +555,8 @@ def measure_dog_baseline(xml_path):
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     _baseline_cache_store(cache)
-    return total_vox / t_total
+    _RUN_BASELINES["dog"] = total_vox / t_total
+    return _RUN_BASELINES["dog"]
 
 
 def measure_dog(xml_path):
@@ -515,6 +590,97 @@ def measure_dog(xml_path):
         "vs_baseline": round(total_vox / dt / cpu, 3),
         "baseline_vox_per_sec": round(cpu, 1),
         "spans": spans,
+    }
+
+
+def measure_dog_kernel(xml_path):
+    """Device-resident DoG detection: the production device program
+    (on-device pool-by-``rel`` + normalization, Toeplitz/FFT blurs,
+    separable extrema, top-K compaction, vectorized quadratic subpixel —
+    the same kernel ``detect_interest_points`` dispatches through
+    ``_make_dog_kernel``) timed with its haloed level-res input blocks
+    already in HBM and only the compacted (K,3)+(K,) outputs leaving the
+    device. End-to-end detection through the axon tunnel pays block h2d on
+    a shared wire; this isolates the framework's device compute rate
+    (counterpart of affine_fusion_kernel_voxels_per_sec for the detection
+    stage; reference device work: SparkInterestPointDetection.java:552-568)."""
+    import numpy as np
+
+    import jax
+
+    from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+    from bigstitcher_spark_tpu.io.spimdata import SpimData
+    from bigstitcher_spark_tpu.models.detection import (
+        DetectionParams, _ViewPlan, _make_dog_kernel,
+    )
+    from bigstitcher_spark_tpu.ops.dog import dog_halo
+    from bigstitcher_spark_tpu.utils.grid import create_grid
+
+    sd = SpimData.load(xml_path)
+    loader = ViewLoader(sd)
+    views = sd.view_ids()
+    params = DetectionParams(min_intensity=0.0, max_intensity=65535.0)
+    halo = dog_halo(params.sigma)
+    bs = tuple(int(b) for b in params.block_size)
+
+    # bucket by geometry FIRST (mirrors detect_interest_points' shape/rel
+    # bucketing), then read + stage only the winning bucket's haloed
+    # level-res blocks (native dtype) — losing buckets are never read
+    buckets: dict[tuple, list] = {}  # (lvl shape, rel) -> [(plan, off, core_vox)]
+    for v in views:
+        plan = _ViewPlan(loader, v, params.downsampling)
+        for blk in create_grid(plan.det_dims, bs):
+            off = [int(o) - halo for o in blk.offset]
+            shape = tuple((int(s) + 2 * halo) * r
+                          for s, r in zip(blk.size, plan.rel))
+            buckets.setdefault((shape, plan.rel), []).append(
+                (plan, off, int(np.prod(blk.size))))
+    (shape, rel), picked = max(buckets.items(), key=lambda kv: len(kv[1]))
+    blocks = []
+    for plan, off, core in picked:
+        raw = plan.read_raw_block(
+            loader, off, [s // r for s, r in zip(shape, rel)])
+        if raw.dtype.byteorder == ">":
+            raw = raw.astype(raw.dtype.newbyteorder("="))
+        blocks.append((raw[None], np.array(off, np.int32)[None], core))
+    kernel = _make_dog_kernel(1, params, rel)
+    # production per-device packing: run_sharded_batches groups
+    # max(1, batch_size // prod(rel)) blocks per batch-axis dispatch
+    # (models/detection.py per_dev scaling)
+    per_dev = max(1, params.batch_size // int(np.prod(rel)))
+    dev = []
+    for i in range(0, len(blocks), per_dev):
+        grp = blocks[i:i + per_dev]
+        dev.append((jax.device_put(np.concatenate([b for b, _, _ in grp])),
+                    jax.device_put(np.concatenate([o for _, o, _ in grp])),
+                    np.full(len(grp), params.min_intensity, np.float32),
+                    np.full(len(grp), params.max_intensity, np.float32),
+                    np.full(len(grp), params.threshold, np.float32)))
+    core_vox = sum(cv for _, _, cv in blocks)
+    for b, o, lo, hi, thr in dev:  # warm: one compile per batch shape
+        outs = kernel(b, lo, hi, thr, o)
+    jax.block_until_ready(outs)
+    reps = 10
+    t0 = time.time()
+    for _ in range(reps):
+        outs = [kernel(b, lo, hi, thr, o) for b, o, lo, hi, thr in dev]
+        jax.block_until_ready(outs)
+    per_rep = (time.time() - t0) / reps
+    cpu = measure_dog_baseline(xml_path)
+    value = core_vox / per_rep
+    return {
+        "metric": "dog_kernel_voxels_per_sec",
+        "value": round(value, 1),
+        "unit": "voxel/s",
+        "blocks": len(blocks),
+        "blocks_per_dispatch": per_dev,
+        "vs_baseline": round(value / cpu, 3),
+        "baseline_vox_per_sec": round(cpu, 1),
+        "note": ("haloed level-res blocks in HBM, compacted top-K outputs "
+                 "only; dispatch+compute, production per-device batch "
+                 "packing; baseline includes its volume read (it prices "
+                 "the full CPU stage — see dog_detection_vox_per_sec for "
+                 "the like-for-like end-to-end comparison)"),
     }
 
 
@@ -861,37 +1027,11 @@ def _checkpoint(result):
     os.replace(tmp, path)
 
 
-def child_main():
+def _validate_fusion(xml, ds):
+    """The XLA output must agree with the baseline implementation
+    (same math, independent code path) on the first block."""
     import numpy as np
 
-    _log("child start")
-    xml = build_fixture()
-    _log("fixture ready")
-    out = os.path.join(FIXTURE, "fused.ome.zarr")
-    baseline = measure_baseline(xml)
-    _log(f"baseline {baseline:.0f} vox/s")
-    from bigstitcher_spark_tpu import profiling
-
-    run_fusion(xml, out)  # warm-up: compiles all kernel variants
-    _log("warmup fusion done")
-    best = None
-    best_spans = {}
-    try:
-        for i in range(FUSION_RUNS):
-            profiling.enable(True)
-            profiling.get().reset()
-            stats, ds, bbox = run_fusion(xml, out)
-            v = stats.voxels / max(stats.seconds, 1e-9)
-            _log(f"fusion run {i + 1}/{FUSION_RUNS}: {v:,.0f} vox/s "
-                 f"({stats.seconds:.2f}s)")
-            if best is None or v > best[0]:
-                best = (v, stats, ds)
-                best_spans = _spans_snapshot()
-    finally:
-        profiling.enable(False)
-    vox_per_sec, stats, ds = best
-    # validate: the XLA output must agree with the baseline implementation
-    # (same math, independent code path) on the first block
     from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
     from bigstitcher_spark_tpu.io.spimdata import SpimData
     from bigstitcher_spark_tpu.utils.geometry import Interval
@@ -907,31 +1047,90 @@ def child_main():
     diff = np.abs(got_blk.astype(np.float64) - ref_blk.astype(np.float64))
     assert float(diff.mean()) < 1.0 and float(got_blk.std()) > 0.0, (
         f"XLA fusion disagrees with baseline: mean|diff|={diff.mean():.3f}")
-    _log("validation ok")
-    import jax
 
-    result = {
+
+def _primary_result(vox_per_sec, baseline, platform, spans,
+                    runs_done=FUSION_RUNS):
+    res = {
         "metric": "affine_fusion_voxels_per_sec",
         "value": round(vox_per_sec, 1),
         "unit": "voxel/s",
         "vs_baseline": round(vox_per_sec / baseline, 3),
-        "platform": jax.devices()[0].platform,
+        "platform": platform,
         "baseline_vox_per_sec": round(baseline, 1),
         "baseline_provenance": (
             "measured in this run (same host, same process weather); "
             "history in BASELINE_MEASURED.json"),
-        "best_of_runs": FUSION_RUNS,
-        "spans": best_spans,
+        "best_of_runs": runs_done,
+        "spans": spans,
         "extra_metrics": [],
     }
+    if platform not in ("cpu",):
+        res["note"] = (
+            "end-to-end pays tile h2d + fused-output d2h over the axon "
+            "tunnel (a cost the in-process CPU baseline does not have) "
+            "plus the host-side chunk write; see spans and the *_kernel_* "
+            "extra metrics for the on-device compute rates and "
+            "wire_d2h_mb_per_sec for the measured wire")
+    return res
+
+
+# the extras pipeline: salvage reporting derives its denominator from this
+EXTRA_MEASURES = (
+    ("kernel", lambda xml: measure_kernel_only(xml)),
+    ("phasecorr", lambda xml: measure_phasecorr(xml)),
+    ("phasecorr_kernel", lambda xml: measure_phasecorr_kernel(xml)),
+    ("dog", lambda xml: measure_dog(xml)),
+    ("dog_kernel", lambda xml: measure_dog_kernel(xml)),
+    ("multitp", lambda xml: measure_multitp()),
+    ("nonrigid", lambda xml: measure_nonrigid()),
+)
+
+
+def child_main():
+    _log("child start")
+    xml = build_fixture()
+    _log("fixture ready")
+    out = os.path.join(FIXTURE, "fused.ome.zarr")
+    baseline = measure_baseline(xml)
+    _log(f"baseline {baseline:.0f} vox/s")
+    from bigstitcher_spark_tpu import profiling
+
+    run_fusion(xml, out)  # warm-up: compiles all kernel variants
+    _log("warmup fusion done")
+    import jax
+
+    platform = jax.devices()[0].platform
+    best_v = 0.0
+    best_spans = {}
+    validated = False
+    try:
+        for i in range(FUSION_RUNS):
+            profiling.enable(True)
+            profiling.get().reset()
+            stats, ds, bbox = run_fusion(xml, out)
+            v = stats.voxels / max(stats.seconds, 1e-9)
+            _log(f"fusion run {i + 1}/{FUSION_RUNS}: {v:,.0f} vox/s "
+                 f"({stats.seconds:.2f}s)")
+            if v > best_v:
+                best_v, best_spans = v, _spans_snapshot()
+            profiling.enable(False)
+            if not validated:
+                _validate_fusion(xml, ds)
+                _log("validation ok")
+                validated = True
+            # checkpoint after EVERY run: a tunnel hang mid-best-of must not
+            # void the completed, validated runs (observed: attempt hung on
+            # run 5/5 with four good runs that would otherwise be lost)
+            _checkpoint(_primary_result(best_v, baseline, platform,
+                                        best_spans, runs_done=i + 1))
+    finally:
+        profiling.enable(False)
+    result = _primary_result(best_v, baseline, platform, best_spans)
     _checkpoint(result)
-    for name, fn in (("kernel", lambda: measure_kernel_only(xml)),
-                     ("phasecorr", lambda: measure_phasecorr(xml)),
-                     ("dog", lambda: measure_dog(xml)),
-                     ("multitp", measure_multitp),
-                     ("nonrigid", measure_nonrigid)):
+    for name, fn in EXTRA_MEASURES:
         try:
-            m = fn()
+            m = fn(xml)
         except Exception as e:  # a failed extra must not void the primary
             _log(f"{name} failed: {e!r}")
             m = {"metric": name, "error": repr(e)[:200]}
@@ -951,7 +1150,8 @@ def _salvage_partial(partial_path, label):
     if res.get("metric") and res.get("value"):
         res["partial"] = True
         print(f"[bench] {label}: salvaged partial result "
-              f"(extras done: {len(res.get('extra_metrics', []))}/5)",
+              f"(extras done: {len(res.get('extra_metrics', []))}"
+              f"/{len(EXTRA_MEASURES)})",
               file=sys.stderr)
         return json.dumps(res)
     return None
@@ -962,8 +1162,12 @@ def _spawn_child(env_extra, label):
     env.update(env_extra)
     env["BST_BENCH_CHILD"] = "1"
     tag = label.replace(" ", "_").replace("/", "-")
-    partial_path = os.path.join(FIXTURE, f"partial_{tag}.json")
-    log_path = os.path.join(FIXTURE, f"child_{tag}.log")
+    # logs/partials live OUTSIDE the fixture dir: build_fixture rmtree's
+    # FIXTURE on a fresh host, which used to unlink the live child log
+    logdir = FIXTURE.rstrip("/") + "_logs"
+    os.makedirs(logdir, exist_ok=True)
+    partial_path = os.path.join(logdir, f"partial_{tag}.json")
+    log_path = os.path.join(logdir, f"child_{tag}.log")
     env["BST_BENCH_PARTIAL"] = partial_path
     for p in (partial_path, log_path):
         try:
