@@ -10,21 +10,23 @@ SLEEP=${2:-240}
 TAG=${3:-r05}
 for i in $(seq 1 "$ATTEMPTS"); do
   if timeout 150 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d; print('live', d[0].platform)" >/tmp/tpu_probe.log 2>&1; then
-    echo "[loop $(date +%T)] tunnel live ($(cat /tmp/tpu_probe.log)), running bench"
-    if timeout 5500 env BST_BENCH_TPU_ONLY=1 BST_BENCH_CHILD_TIMEOUT=2500 python bench.py >/tmp/bench_tpu_out.json 2>/tmp/bench_tpu_err.log; then
-      if grep -q '"platform": "cpu"' /tmp/bench_tpu_out.json; then
-        echo "[loop $(date +%T)] bench fell back to cpu; retrying later"
-      else
-        cp /tmp/bench_tpu_out.json "BENCH_TPU_${TAG}.json"
-        cp /tmp/bench_tpu_err.log "BENCH_TPU_${TAG}.log"
-        echo "[loop $(date +%T)] TPU BENCH CAPTURED:"
-        cat "BENCH_TPU_${TAG}.json"
-        exit 0
-      fi
-    else
-      echo "[loop $(date +%T)] bench rc=$? (see /tmp/bench_tpu_err.log tail):"
-      tail -5 /tmp/bench_tpu_err.log
+    echo "[loop $(date +%T)] tunnel live ($(tail -1 /tmp/tpu_probe.log)), running bench"
+    timeout 5500 env BST_BENCH_TPU_ONLY=1 BST_BENCH_CHILD_TIMEOUT=2500 python bench.py >/tmp/bench_tpu_out.json 2>/tmp/bench_tpu_err.log
+    rc=$?
+    # capture only a real, non-fallback artifact: rc 0 plus one JSON line
+    # holding the primary metric on a non-cpu platform (an empty stdout
+    # with rc=0 — e.g. the bench tree getting SIGTERM'd — must not
+    # become the record)
+    if [ "$rc" -eq 0 ] && grep -q '"metric"' /tmp/bench_tpu_out.json \
+        && ! grep -q '"platform": "cpu"' /tmp/bench_tpu_out.json; then
+      cp /tmp/bench_tpu_out.json "BENCH_TPU_${TAG}.json"
+      cp /tmp/bench_tpu_err.log "BENCH_TPU_${TAG}.log"
+      echo "[loop $(date +%T)] TPU BENCH CAPTURED:"
+      cat "BENCH_TPU_${TAG}.json"
+      exit 0
     fi
+    echo "[loop $(date +%T)] no TPU artifact (rc=$rc); stderr tail:"
+    tail -5 /tmp/bench_tpu_err.log
   else
     echo "[loop $(date +%T)] tunnel unreachable (attempt $i/$ATTEMPTS)"
   fi
