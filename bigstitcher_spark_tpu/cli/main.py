@@ -48,6 +48,7 @@ cli.add_command(intensity_tools.solve_intensities_cmd, "solve-intensities")
 cli.add_command(utility_tools.inspect_interestpoints_cmd, "inspect-interestpoints")
 cli.add_command(utility_tools.map_setup_ids_cmd, "map-setup-ids")
 cli.add_command(utility_tools.env_cmd, "env")
+cli.add_command(utility_tools.serve_container_cmd, "serve-container")
 
 
 def main():

@@ -346,3 +346,53 @@ def env_cmd():
         click.echo(f"s3 region: {uris.get_s3_region()}")
     if uris.get_s3_endpoint():
         click.echo(f"s3 endpoint: {uris.get_s3_endpoint()}")
+
+
+def make_container_server(root: str, port: int = 0):
+    """HTTP server over a local container directory with CORS headers
+    (browser viewers — neuroglancer in particular — refuse cross-origin
+    chunk fetches without Access-Control-Allow-Origin). port=0 binds an
+    ephemeral port; the caller reads ``server_address``."""
+    import functools
+    import http.server
+
+    class Handler(http.server.SimpleHTTPRequestHandler):
+        def end_headers(self):
+            self.send_header("Access-Control-Allow-Origin", "*")
+            super().end_headers()
+
+        def log_message(self, *args):  # keep the CLI output readable
+            pass
+
+    return http.server.ThreadingHTTPServer(
+        ("127.0.0.1", port), functools.partial(Handler, directory=root))
+
+
+@click.command()
+@click.argument("container", type=click.Path(exists=True, file_okay=False))
+@click.option("--port", type=int, default=8399, show_default=True,
+              help="listen port (0 picks a free one)")
+def serve_container_cmd(container, port):
+    """Serve a local fusion container over HTTP for interactive preview —
+    the headless counterpart of the reference's --displayResult BDV window
+    (SplitDatasets.java:131) and GUI loading probe
+    (cloud/TestN5Loading.java:115-143). Open the printed source in
+    neuroglancer, or point BigDataViewer/Fiji (Open N5/OME-ZARR via URL)
+    at the served address."""
+    import os
+
+    srv = make_container_server(container, port)
+    host, p = srv.server_address
+    fmt = ("n5" if os.path.exists(os.path.join(container, "attributes.json"))
+           else "zarr")
+    click.echo(f"serving {container} at http://{host}:{p}/ (CORS enabled)")
+    click.echo(f"neuroglancer source: {fmt}://http://{host}:{p}/<dataset>")
+    click.echo("BigDataViewer/Fiji: Plugins > BigDataViewer > "
+               f"Open N5/OME-ZARR -> http://{host}:{p}/")
+    click.echo("Ctrl-C to stop")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()

@@ -198,3 +198,32 @@ def test_env_diagnostics_command():
     assert r.exit_code == 0, r.output
     assert "native codec:" in r.output
     assert "backend:" in r.output
+
+
+def test_serve_container_cors(tmp_path):
+    """serve-container's HTTP server exposes container files with the CORS
+    header browser viewers (neuroglancer) require."""
+    import json
+    import threading
+    import urllib.request
+
+    from bigstitcher_spark_tpu.cli.utility_tools import make_container_server
+
+    root = tmp_path / "fused.zarr"
+    (root / "0").mkdir(parents=True)
+    meta = {"zarr_format": 2}
+    (root / "0" / ".zarray").write_text(json.dumps(meta))
+    srv = make_container_server(str(root), 0)
+    host, port = srv.server_address
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/0/.zarray", timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Access-Control-Allow-Origin"] == "*"
+            assert json.loads(resp.read()) == meta
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        t.join(timeout=10)
