@@ -798,9 +798,7 @@ def measure_multitp():
         return ds
 
     run()  # warm compiles
-    t0 = time.time()
-    ds = run()
-    dt = time.time() - t0
+    dt, ds, spans = _best_timed(1, run)  # single timed run, span-profiled
     vox = int(np.prod(bbox.shape)) * n_ch * n_tp
 
     # baseline: the same numpy fusion per slot (cached)
@@ -845,6 +843,7 @@ def measure_multitp():
         "slots": n_ch * n_tp,
         "vs_baseline": round(vox / dt / base, 3),
         "baseline_vox_per_sec": round(base, 1),
+        "spans": spans,
     }
 
 
@@ -967,9 +966,7 @@ def measure_nonrigid():
         return ds
 
     run()  # warm compiles
-    t0 = time.time()
-    ds = run()
-    dt = time.time() - t0
+    dt, ds, spans = _best_timed(1, run)  # single timed run, span-profiled
     vox = int(np.prod(bbox.shape))
 
     cache = _baseline_cache_load()
@@ -1006,6 +1003,7 @@ def measure_nonrigid():
         "unit": "voxel/s",
         "vs_baseline": round(vox / dt / base, 3),
         "baseline_vox_per_sec": round(base, 1),
+        "spans": spans,
     }
 
 
