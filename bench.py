@@ -45,6 +45,10 @@ CHILD_TIMEOUT_S = int(os.environ.get("BST_BENCH_CHILD_TIMEOUT", 1500))
 TPU_ATTEMPTS = 2
 # same-process baseline memo (one measurement per bench child)
 _RUN_BASELINES: dict = {}
+# a device call that exceeds this is a tunnel stall, not a slow run: the
+# timed fusion runs take seconds and every extra is <60 s warm, so 300 s
+# means the accelerator went away mid-attempt
+DEVICE_TIMEOUT_S = int(os.environ.get("BST_BENCH_DEVICE_TIMEOUT", 300))
 # best-of-N: wall-clock noise on a shared host (and tunnel weather on TPU)
 # swings single runs ~30%; five runs stabilize the headline artifact
 FUSION_RUNS = int(os.environ.get("BST_BENCH_RUNS", 5))
@@ -1073,6 +1077,48 @@ def _primary_result(vox_per_sec, baseline, platform, spans,
     return res
 
 
+class _DeviceStall(Exception):
+    pass
+
+
+def _run_with_watchdog(fn, timeout_s=None):
+    """Run ``fn`` in a worker thread; raise _DeviceStall if it doesn't
+    finish in time. A hung XLA device call blocks its thread forever (the
+    tunnel drops without erroring), so the hung worker is simply abandoned
+    (daemon) and the caller finalizes what it has instead of burning the
+    rest of the child time budget waiting for SIGKILL."""
+    import threading
+
+    out: dict = {}
+
+    def work():
+        try:
+            out["r"] = fn()
+        except BaseException as e:  # noqa: BLE001 — reraised below
+            out["e"] = e
+
+    th = threading.Thread(target=work, daemon=True)
+    th.start()
+    th.join(timeout_s or DEVICE_TIMEOUT_S)
+    if th.is_alive():
+        raise _DeviceStall(f"device call stalled >{timeout_s or DEVICE_TIMEOUT_S}s")
+    if "e" in out:
+        raise out["e"]
+    return out["r"]
+
+
+def _finalize(result, truncated=None):
+    """Print the artifact line and exit without waiting on wedged XLA
+    threads (a normal interpreter exit can hang in runtime teardown)."""
+    if truncated:
+        result["truncated"] = truncated
+        _log(f"finalizing early: {truncated}")
+    _checkpoint(result)
+    print(json.dumps(result))
+    sys.stdout.flush()
+    os._exit(0)
+
+
 # the extras pipeline: salvage reporting derives its denominator from this
 EXTRA_MEASURES = (
     ("kernel", lambda xml: measure_kernel_only(xml)),
@@ -1094,7 +1140,15 @@ def child_main():
     _log(f"baseline {baseline:.0f} vox/s")
     from bigstitcher_spark_tpu import profiling
 
-    run_fusion(xml, out)  # warm-up: compiles all kernel variants
+    try:  # warm-up: compiles all kernel variants (first device contact —
+        # a stall here means the tunnel died between probe and child)
+        _run_with_watchdog(lambda: run_fusion(xml, out),
+                           max(DEVICE_TIMEOUT_S, 600))
+    except _DeviceStall as e:
+        # os._exit: interpreter teardown can itself hang on the wedged
+        # XLA runtime threads
+        _log(f"warmup stalled ({e}); aborting attempt early")
+        os._exit(1)
     _log("warmup fusion done")
     import jax
 
@@ -1102,12 +1156,25 @@ def child_main():
     best_v = 0.0
     best_spans = {}
     validated = False
+    runs_done = 0
     try:
         for i in range(FUSION_RUNS):
             profiling.enable(True)
             profiling.get().reset()
-            stats, ds, bbox = run_fusion(xml, out)
+            try:
+                stats, ds, bbox = _run_with_watchdog(
+                    lambda: run_fusion(xml, out))
+            except _DeviceStall as e:
+                if not validated:
+                    _log(f"run {i + 1} stalled before validation ({e})")
+                    os._exit(1)
+                # completed validated runs survive the stall: finalize now
+                # instead of burning the rest of the child time budget
+                _finalize(_primary_result(best_v, baseline, platform,
+                                          best_spans, runs_done=runs_done),
+                          truncated=f"fusion run {i + 1}: {e}")
             v = stats.voxels / max(stats.seconds, 1e-9)
+            runs_done = i + 1
             _log(f"fusion run {i + 1}/{FUSION_RUNS}: {v:,.0f} vox/s "
                  f"({stats.seconds:.2f}s)")
             if v > best_v:
@@ -1121,14 +1188,20 @@ def child_main():
             # void the completed, validated runs (observed: attempt hung on
             # run 5/5 with four good runs that would otherwise be lost)
             _checkpoint(_primary_result(best_v, baseline, platform,
-                                        best_spans, runs_done=i + 1))
+                                        best_spans, runs_done=runs_done))
     finally:
         profiling.enable(False)
     result = _primary_result(best_v, baseline, platform, best_spans)
     _checkpoint(result)
     for name, fn in EXTRA_MEASURES:
         try:
-            m = fn(xml)
+            m = _run_with_watchdog(lambda: fn(xml))
+        except _DeviceStall as e:
+            # the tunnel is gone; remaining extras would stall too — ship
+            # the primary + completed extras as a truncated artifact
+            result["extra_metrics"].append(
+                {"metric": name, "error": str(e)})
+            _finalize(result, truncated=f"extra '{name}': {e}")
         except Exception as e:  # a failed extra must not void the primary
             _log(f"{name} failed: {e!r}")
             m = {"metric": name, "error": repr(e)[:200]}

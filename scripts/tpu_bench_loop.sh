@@ -19,14 +19,23 @@ for i in $(seq 1 "$ATTEMPTS"); do
     # become the record)
     if [ "$rc" -eq 0 ] && grep -q '"metric"' /tmp/bench_tpu_out.json \
         && ! grep -q '"platform": "cpu"' /tmp/bench_tpu_out.json; then
-      cp /tmp/bench_tpu_out.json "BENCH_TPU_${TAG}.json"
-      cp /tmp/bench_tpu_err.log "BENCH_TPU_${TAG}.log"
-      echo "[loop $(date +%T)] TPU BENCH CAPTURED:"
-      cat "BENCH_TPU_${TAG}.json"
-      exit 0
+      if grep -q '"truncated"' /tmp/bench_tpu_out.json; then
+        # a tunnel stall cut this attempt short mid-artifact: keep it (it
+        # has a validated primary) but keep hunting for a complete one
+        cp /tmp/bench_tpu_out.json "BENCH_TPU_${TAG}.json"
+        cp /tmp/bench_tpu_err.log "BENCH_TPU_${TAG}.log"
+        echo "[loop $(date +%T)] truncated TPU artifact saved; retrying for a complete one"
+      else
+        cp /tmp/bench_tpu_out.json "BENCH_TPU_${TAG}.json"
+        cp /tmp/bench_tpu_err.log "BENCH_TPU_${TAG}.log"
+        echo "[loop $(date +%T)] TPU BENCH CAPTURED:"
+        cat "BENCH_TPU_${TAG}.json"
+        exit 0
+      fi
+    else
+      echo "[loop $(date +%T)] no TPU artifact (rc=$rc); stderr tail:"
+      tail -5 /tmp/bench_tpu_err.log
     fi
-    echo "[loop $(date +%T)] no TPU artifact (rc=$rc); stderr tail:"
-    tail -5 /tmp/bench_tpu_err.log
   else
     echo "[loop $(date +%T)] tunnel unreachable (attempt $i/$ATTEMPTS)"
   fi
