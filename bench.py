@@ -41,6 +41,12 @@ FIXTURE_SPEC = {
     "jitter": 0.0, "seed": 11, "block_size": (128, 128, 64),
     "n_beads_per_tile": 120,
 }
+# optional fixture scaling for throughput-vs-volume experiments (PERF.md):
+# BST_BENCH_TILE=384 runs the primary config with (384,384,192) tiles;
+# the baseline cache keys on the full spec, so scales never cross-pollute
+if os.environ.get("BST_BENCH_TILE"):
+    _t = int(os.environ["BST_BENCH_TILE"])
+    FIXTURE_SPEC["tile_size"] = (_t, _t, max(64, _t // 2))
 CHILD_TIMEOUT_S = int(os.environ.get("BST_BENCH_CHILD_TIMEOUT", 1500))
 TPU_ATTEMPTS = 2
 # same-process baseline memo (one measurement per bench child)
