@@ -500,7 +500,10 @@ def _try_fuse_volume_device(
     with profiling.span("fusion.kernel"):
         out = dispatch_composite(cp, tiles, fusion_type, out_dtype, masks,
                                  min_intensity, max_intensity)
-        out.block_until_ready()
+        if profiling.get().enabled:
+            # span attribution only: costs one round-trip, so skip it when
+            # nobody reads the spans (the drain's D2H is the real sync)
+            profiling.device_sync(out)
     return out
 
 
