@@ -60,6 +60,12 @@ class StitchingParams:
     # 2x2 fixture's corner pairs at full resolution)
     min_overlap_frac: float = 0.25
     batch_size: int = 16
+    # ceiling on ONE segment's padded crop-stack bytes: within a segment
+    # every bucket's program is dispatched and ALL peak tables come back
+    # in one pipelined fetch, so per-sync round-trip latency is paid per
+    # segment, not per shape bucket. Two segments are in flight at once
+    # (refine overlaps compute), so size for ~2x this value pinned.
+    inflight_bytes: int = 1 << 30
 
 
 @dataclass
@@ -317,12 +323,17 @@ def stitch_jobs(sd, jobs: list[_PairJob], params: StitchingParams
                 ) -> list[PairwiseStitchingResult]:
     """Run the device PCM + host refinement pipeline over prepared jobs.
 
-    Device programs are dispatched ahead of the host refinement loop
-    (JAX dispatch is async), so refinement of batch k overlaps the device
-    FFTs of batch k+1 — but only a bounded window of batches is in flight
-    at once: each dispatched batch pins its padded crop stacks until it
-    executes, so dispatch-everything would make peak device memory grow
-    with the total pair count instead of the batch size."""
+    Chunks (shape-bucketed pair batches) are grouped into SEGMENTS whose
+    padded crop stacks together stay under ``params.inflight_bytes``: a
+    segment's programs all dispatch back-to-back (JAX dispatch is async)
+    and their peak tables come back in ONE pipelined ``jax.device_get``,
+    so the per-sync round-trip — which dominates small workloads on a
+    tunneled device — is paid once per segment instead of once per shape
+    bucket. Host refinement of segment k overlaps the device FFTs of
+    segment k+1, so up to TWO segments' input stacks (~2x the ceiling)
+    are pinned at once — bounded by the knob, not the total pair count."""
+    import jax
+
     buckets: dict[tuple, list[_PairJob]] = {}
     for j in jobs:
         shp = _fft_shape(np.maximum(j.crop_a.shape, j.crop_b.shape))
@@ -333,24 +344,36 @@ def stitch_jobs(sd, jobs: list[_PairJob], params: StitchingParams
         for i in range(0, len(bjobs), params.batch_size):
             chunks.append((shp, bjobs[i:i + params.batch_size]))
 
-    window = 2  # double buffering: refine batch k while k+1 computes
-    in_flight: list[tuple] = []
+    segments: list[list[tuple]] = []
+    cur, cur_bytes = [], 0
+    for shp, chunk in chunks:
+        nbytes = 2 * len(chunk) * int(np.prod(shp)) * 4  # a+b f32 stacks
+        if cur and cur_bytes + nbytes > params.inflight_bytes:
+            segments.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append((shp, chunk))
+        cur_bytes += nbytes
+    if cur:
+        segments.append(cur)
+
     results: list[PairwiseStitchingResult] = []
 
-    def drain_one():
-        shp, chunk, peaks_dev = in_flight.pop(0)
+    def drain(seg_devs):
         with profiling.span("stitching.kernel_sync"):
-            peaks = np.asarray(peaks_dev)  # blocks on the device program
-        results.extend(_refine_bucket(sd, chunk, shp, peaks, params))
+            peaks_list = jax.device_get([d for _, _, d in seg_devs])
+        for (shp, chunk, _), peaks in zip(seg_devs, peaks_list):
+            results.extend(_refine_bucket(sd, chunk, shp, peaks, params))
 
-    for shp, chunk in chunks:
+    prev = None
+    for seg in segments:
         with profiling.span("stitching.kernel"):
-            in_flight.append((shp, chunk,
-                              _dispatch_bucket(chunk, shp, params)))
-        if len(in_flight) >= window:
-            drain_one()
-    while in_flight:
-        drain_one()
+            seg_devs = [(shp, chunk, _dispatch_bucket(chunk, shp, params))
+                        for shp, chunk in seg]
+        if prev is not None:
+            drain(prev)
+        prev = seg_devs
+    if prev is not None:
+        drain(prev)
     return results
 
 
