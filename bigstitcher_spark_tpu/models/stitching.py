@@ -19,6 +19,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Sequence
 
+import jax
 import numpy as np
 
 from ..io.dataset_io import ViewLoader, best_mipmap_level
@@ -332,8 +333,6 @@ def stitch_jobs(sd, jobs: list[_PairJob], params: StitchingParams
     bucket. Host refinement of segment k overlaps the device FFTs of
     segment k+1, so up to TWO segments' input stacks (~2x the ceiling)
     are pinned at once — bounded by the knob, not the total pair count."""
-    import jax
-
     buckets: dict[tuple, list[_PairJob]] = {}
     for j in jobs:
         shp = _fft_shape(np.maximum(j.crop_a.shape, j.crop_b.shape))
