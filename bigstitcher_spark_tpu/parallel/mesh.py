@@ -19,6 +19,7 @@ out).
 from __future__ import annotations
 
 import functools
+import os
 
 from typing import Sequence
 
@@ -161,11 +162,17 @@ def run_sharded_batches(
     ``i``'s slice of each output (e.g. disjoint chunk writes — no locks
     needed, the reference's no-shuffle invariant).
 
-    Host prefetch for batch k+1 overlaps device compute for batch k (double
-    buffering); batches are resubmitted on failure via run_with_retry, and
-    completed batches are tracked so retry rounds neither re-run them nor
-    leak prefetch futures. ``per_dev`` packs that many items per device per
-    batch (compute-light kernels amortize dispatch by batching more).
+    Host prefetch for batch k+1 overlaps device compute for batch k, and
+    when batch k+1's inputs are already staged its program is dispatched
+    BEFORE batch k's outputs are fetched — the device computes k+1 while
+    k's outputs cross the wire and write (device double buffering; up to
+    two batches' arrays resident). Batches are resubmitted on failure via
+    run_with_retry, and completed batches are tracked so retry rounds
+    neither re-run them nor leak prefetch futures; early-dispatched
+    results are keyed per batch and rebuilt on retry, so failure
+    granularity is unchanged. ``per_dev`` packs that many items per
+    device per batch (compute-light kernels amortize dispatch by
+    batching more).
 
     ``multihost=True`` (block-writing stages only — outputs must be disjoint
     chunks) first takes this process's deterministic slice of ``items``, so
@@ -182,19 +189,10 @@ def run_sharded_batches(
     if not batches:
         return
     prefetched = {0: [pool.submit(build, it) for it in batches[0]]}
+    dispatched: dict[int, tuple] = {}
     completed: set[int] = set()
 
-    def process_batch(bi_batch):
-        bi, batch = bi_batch
-        if bi in completed:
-            return
-        futs = prefetched.pop(bi, None)
-        if futs is None:  # retry round: prefetch again
-            futs = [pool.submit(build, it) for it in batch]
-        nxt = bi + 1
-        if nxt < len(batches) and nxt not in prefetched and nxt not in completed:
-            prefetched[nxt] = [pool.submit(build, it) for it in batches[nxt]]
-        inputs = [f.result() for f in futs]
+    def stack_and_dispatch(inputs):
         # pad to a multiple of n_dev (the sharding constraint), NOT to the
         # full group size: a tail batch of 4 on 1 device must not run as 8
         # blocks of which half are zero work (the jit re-specializes once
@@ -205,9 +203,48 @@ def run_sharded_batches(
             -(-len(inputs) // max(n_dev, 1)) * max(n_dev, 1),
         )
         outs = kernel(*stacked)
-        if not isinstance(outs, (tuple, list)):
-            outs = (outs,)
-        outs = [np.asarray(o) for o in outs]
+        return outs if isinstance(outs, (tuple, list)) else (outs,)
+
+    def process_batch(bi_batch):
+        bi, batch = bi_batch
+        if bi in completed:
+            return
+        outs = dispatched.pop(bi, None)
+        if outs is None:
+            futs = prefetched.pop(bi, None)
+            if futs is None:  # retry round: prefetch again
+                futs = [pool.submit(build, it) for it in batch]
+            outs = stack_and_dispatch([f.result() for f in futs])
+        nxt = bi + 1
+        if nxt < len(batches) and nxt not in completed:
+            if nxt not in prefetched and nxt not in dispatched:
+                prefetched[nxt] = [pool.submit(build, it) for it in batches[nxt]]
+            futs = prefetched.get(nxt)
+            # BST_EARLY_DISPATCH=0 opts out: early dispatch keeps up to
+            # TWO batches' arrays resident (2x the per_dev budget callers
+            # size for), which matters only when BST_PER_DEV_BUDGET is
+            # pushed toward HBM capacity
+            if (futs is not None and all(f.done() for f in futs)
+                    and os.environ.get("BST_EARLY_DISPATCH", "1") == "1"):
+                # next batch's inputs are staged: put its program on the
+                # device stream NOW so it computes while this batch's
+                # outputs cross the wire and write (the fetch below only
+                # waits on THIS batch's buffers — a data dependency)
+                del prefetched[nxt]
+                try:
+                    dispatched[nxt] = stack_and_dispatch(
+                        [f.result() for f in futs])
+                except Exception:
+                    # a build/dispatch error belongs to batch nxt, not to
+                    # this one: let nxt's own process_batch re-stage and
+                    # raise it so retry accounting blames the right batch
+                    pass
+                nxt2 = nxt + 1
+                if (nxt2 < len(batches) and nxt2 not in prefetched
+                        and nxt2 not in completed):
+                    prefetched[nxt2] = [pool.submit(build, it)
+                                        for it in batches[nxt2]]
+        outs = jax.device_get(list(outs))  # pipelined multi-output fetch
         wfuts = [
             pool.submit(consume, it, *(o[i] for o in outs))
             for i, it in enumerate(batch)
