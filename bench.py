@@ -606,6 +606,13 @@ def measure_dog_baseline(xml_path):
             total_vox += int(np.prod(plan.det_dims))
         return total_vox, t_total, n_spots
 
+    # untimed warm pass: the candidate side gets an explicit warm call
+    # before ITS best-of-3, so the baseline must not pay the cold page
+    # cache in its first timed pass (asymmetry behind a 6x cross-run
+    # baseline swing flagged by baseline_drift_flags)
+    for v in sd.view_ids():
+        plan = _ViewPlan(loader, v, params.downsampling)
+        plan.read_det_block(loader, (0, 0, 0), plan.det_dims)
     total_vox, t_total, n_spots = one_pass()
     for _ in range(2):  # best-of-3 both sides: damp shared-host noise
         tv, tt, ns = one_pass()
