@@ -216,14 +216,17 @@ def match_ratio_test(desc_a, owner_a, desc_b, owner_b, ratio,
     rb = _row_block(min(db, 1 << 16))
     cb = 1 << 14
     topk = max(8, max_owner_multiplicity + 2)
-    outs_o, outs_a = [], []
-    for i in range(0, da, rb):
-        chunk = desc_a[i:i + rb]
-        o, a = _match_ratio_row_chunk(chunk, desc_b, owner_b,
-                                      jnp.float32(ratio), cb, topk)
-        outs_o.append(np.asarray(o))
-        outs_a.append(np.asarray(a))
-    return np.concatenate(outs_o), np.concatenate(outs_a)
+    # dispatch every row chunk before fetching: outputs are small index
+    # tables, so all chunks' device programs queue back-to-back and one
+    # pipelined device_get drains them (no per-chunk round-trip)
+    devs = [
+        _match_ratio_row_chunk(desc_a[i:i + rb], desc_b, owner_b,
+                               jnp.float32(ratio), cb, topk)
+        for i in range(0, da, rb)
+    ]
+    got = jax.device_get(devs)
+    return (np.concatenate([o for o, _ in got]),
+            np.concatenate([a for _, a in got]))
 
 
 def match_candidates(
