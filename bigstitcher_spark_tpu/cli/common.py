@@ -3,7 +3,6 @@ abstractcmdline/*.java, re-expressed as click decorator stacks)."""
 
 from __future__ import annotations
 
-import functools
 
 import click
 

@@ -13,7 +13,7 @@ import time
 import click
 import numpy as np
 
-from ..io.chunkstore import ChunkStore, StorageFormat
+from ..io.chunkstore import StorageFormat
 from ..io.container import (
     open_container,
     create_fusion_container,

@@ -25,7 +25,6 @@ from ..models.downsample_driver import (
     validate_pyramid,
 )
 from ..models.resave import propose_pyramid, resave, swap_imgloader
-from ..parallel.retry import run_with_retry
 from ..utils.grid import create_grid
 from .common import (
     infrastructure_options,

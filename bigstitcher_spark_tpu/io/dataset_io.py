@@ -16,7 +16,7 @@ import numpy as np
 
 from ..utils.geometry import identity_affine
 from . import uris
-from .chunkstore import ChunkStore, Dataset, Hdf5Store, StorageFormat
+from .chunkstore import ChunkStore, Dataset, Hdf5Store
 from .spimdata import SpimData, ViewId
 
 

@@ -23,7 +23,6 @@ from ..ops import fusion as F
 from ..utils.geometry import (
     Interval,
     concatenate,
-    concatenate_all,
     invert_affine,
     scale_affine,
     translation_affine,

@@ -30,7 +30,6 @@ from ..ops.intensity import (
 )
 from ..utils.geometry import (
     Interval,
-    concatenate,
     invert_affine,
     transformed_interval,
 )

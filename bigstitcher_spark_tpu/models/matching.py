@@ -20,7 +20,7 @@ smaller than the model's minimum match count are dropped
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 

@@ -13,13 +13,13 @@ disjoint chunks) is preserved by the grid construction.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
 from ..io.chunkstore import ChunkStore, StorageFormat
 from ..io.container import estimate_multires_pyramid, _relative_steps
-from ..io.dataset_io import ViewLoader, bdv_dataset_path, create_bdv_view_datasets
+from ..io.dataset_io import ViewLoader, create_bdv_view_datasets
 from ..io.spimdata import ImageLoader, SpimData, ViewId
 from ..parallel.retry import run_with_retry
 from ..utils.grid import create_grid
