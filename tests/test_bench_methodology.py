@@ -60,6 +60,33 @@ class TestKernelRate:
         assert per >= 1e-9
 
 
+class TestSalvagePartial:
+    """The parent's salvage of a killed child's checkpoint is what turns a
+    tunnel hang into a truncated-but-valid artifact instead of an empty
+    BENCH file — it must accept only checkpoints with a real primary."""
+
+    def test_salvages_checkpoint_with_primary(self, tmp_path):
+        p = tmp_path / "partial.json"
+        p.write_text(json.dumps({"metric": "affine_fusion_voxels_per_sec",
+                                 "value": 123.0, "extra_metrics": []}))
+        line = bench._salvage_partial(str(p), "tpu attempt 1")
+        got = json.loads(line)
+        assert got["partial"] is True and got["value"] == 123.0
+
+    def test_rejects_truncated_json(self, tmp_path):
+        p = tmp_path / "partial.json"
+        p.write_text('{"metric": "affine_f')
+        assert bench._salvage_partial(str(p), "x") is None
+
+    def test_rejects_checkpoint_without_value(self, tmp_path):
+        p = tmp_path / "partial.json"
+        p.write_text(json.dumps({"metric": "m", "value": 0}))
+        assert bench._salvage_partial(str(p), "x") is None
+
+    def test_rejects_missing_file(self, tmp_path):
+        assert bench._salvage_partial(str(tmp_path / "nope.json"), "x") is None
+
+
 class TestBaselineDrift:
     def _with_cache(self, monkeypatch, tmp_path, cache):
         p = tmp_path / "baseline.json"
