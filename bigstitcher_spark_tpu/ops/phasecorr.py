@@ -67,8 +67,8 @@ def _windowed(img: jnp.ndarray, ext: jnp.ndarray, fade_frac: float):
 
 @functools.partial(jax.jit, static_argnames=("n_peaks",))
 def pcm_peaks(
-    a: jnp.ndarray,           # (X,Y,Z) float32, zero-padded crop of group A
-    b: jnp.ndarray,           # (X,Y,Z) float32, zero-padded crop of group B
+    a: jnp.ndarray,           # (X,Y,Z) float32 or uint16 (lossless
+    b: jnp.ndarray,           # transport downcast), zero-padded crops
     ext_a: jnp.ndarray,       # (3,) int32 actual extent of a before padding
     ext_b: jnp.ndarray,       # (3,) int32
     n_peaks: int = 5,
@@ -77,6 +77,11 @@ def pcm_peaks(
     """Top-N local maxima of the phase-correlation matrix -> (n_peaks, 3)
     int32 wrapped indices. The PCM is computed on windowed copies; the
     correlation check happens on the raw crops host-side."""
+    # crops may arrive as uint16 (lossless transport downcast when every
+    # value is integral — halves h2d bytes on wire-limited links); the
+    # kernel math is float32 either way
+    a = a.astype(jnp.float32)
+    b = b.astype(jnp.float32)
     fa = jnp.fft.rfftn(_windowed(a, ext_a, fade_frac))
     fb = jnp.fft.rfftn(_windowed(b, ext_b, fade_frac))
     cross = fa * jnp.conj(fb)

@@ -106,6 +106,30 @@ def test_stitching_recovers_ground_truth(stitch_project):
     assert checked >= 4
 
 
+def test_uint16_transport_is_bit_identical():
+    """The lossless h2d downcast (integral float32 crops sent as uint16,
+    cast back on device) must produce exactly the same peaks, and must
+    not engage for fractional crops (channel averages)."""
+    from bigstitcher_spark_tpu.models.stitching import _as_uint16_lossless
+    from bigstitcher_spark_tpu.ops.phasecorr import pcm_peaks_batch
+
+    rng = np.random.RandomState(1)
+    crop = rng.randint(0, 60000, (2, 16, 64, 64)).astype(np.float32)
+    ext = np.tile(np.array([16, 64, 64], np.int32), (2, 1))
+    pk_f = np.asarray(pcm_peaks_batch(jnp.asarray(crop), jnp.asarray(crop),
+                                      jnp.asarray(ext), jnp.asarray(ext),
+                                      5, 0.25))
+    t = _as_uint16_lossless(crop)
+    assert t is not None and t.dtype == np.uint16
+    pk_u = np.asarray(pcm_peaks_batch(jnp.asarray(t), jnp.asarray(t),
+                                      jnp.asarray(ext), jnp.asarray(ext),
+                                      5, 0.25))
+    np.testing.assert_array_equal(pk_f, pk_u)
+    assert _as_uint16_lossless(crop + 0.5) is None      # fractional
+    assert _as_uint16_lossless(crop - 1e6) is None      # negative
+    assert _as_uint16_lossless(crop + 1e6) is None      # out of range
+
+
 def test_segmented_pipeline_matches_single_segment(stitch_project):
     """A tiny inflight_bytes budget forces one segment per chunk (max
     round-trips); results must be identical to the default single-segment
