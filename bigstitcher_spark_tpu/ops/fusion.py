@@ -131,6 +131,9 @@ def fuse_block_impl(
 
     Weight-sum doubles as the coverage mask for ``--masks`` mode
     (GenerateComputeBlockMasks equivalent)."""
+    # patches may arrive in their stored integer dtype (lossless transport
+    # downcast — halves h2d bytes on wire-limited links); math is float32
+    patches = patches.astype(jnp.float32)
     if inside_offs is None:
         inside_offs = jnp.zeros_like(borders)
     coords = block_coords(block_shape)
@@ -258,6 +261,7 @@ def fuse_block_sep_impl(
 ):
     if inside_offs is None:
         inside_offs = jnp.zeros_like(borders)
+    patches = patches.astype(jnp.float32)  # lossless transport downcast
 
     def one(*args):
         return _one_view_sep(*args, block_shape=block_shape)
@@ -322,6 +326,7 @@ def fuse_block_shift_impl(
 ):
     if inside_offs is None:
         inside_offs = jnp.zeros_like(borders)
+    patches = patches.astype(jnp.float32)  # lossless transport downcast
     vals, insides, wblends = jax.vmap(
         _one_view_shift, in_axes=(0, 0, 0, 0, 0, 0, 0, None)
     )(patches, fracs, lpos0, img_dims, borders, blend_ranges, inside_offs,
