@@ -218,18 +218,19 @@ def build_coefficient_arrays(sd, loader, plans, coefficients, nb):
     return coeffs, coeff_affs
 
 
-def _patch_dtype(loader, plans) -> np.dtype:
-    """The staged patch stack's dtype: the stored dtype when every view
-    shares a <=16-bit integer type — patches then ship to the device at
-    native width and the kernels cast to float32 on device (lossless,
-    halves h2d bytes on wire-limited links) — float32 otherwise."""
+def patch_dtype(loader, view_levels) -> np.dtype:
+    """The staged patch stack's dtype for ``(view, level)`` pairs: the
+    stored dtype when every view shares a <=16-bit integer type — patches
+    then ship to the device at native width and the kernels cast to
+    float32 on device (lossless, halves h2d bytes on wire-limited links)
+    — float32 otherwise. Probes are memoized per (view, level) on the
+    loader for the whole run."""
     memo = loader.__dict__.setdefault("_patch_dtype_memo", {})
     dts = set()
-    for p in plans:
-        key = (p.view, p.level)
+    for key in view_levels:
         d = memo.get(key)
         if d is None:  # probe once per (view, level) for the whole run
-            d = np.dtype(loader.open(p.view, p.level).dtype).newbyteorder("=")
+            d = np.dtype(loader.open(*key).dtype).newbyteorder("=")
             memo[key] = d
         dts.add(d)
     if len(dts) == 1:
@@ -243,7 +244,8 @@ def _gather_inputs(sd, loader, plans, pshape, vb, blend, inside_offset,
                    coefficients):
     """Host-side input staging for the general gather kernel: prefetch the
     clipped source boxes and assemble the per-view parameter arrays."""
-    patches = np.zeros((vb, *pshape), dtype=_patch_dtype(loader, plans))
+    patches = np.zeros((vb, *pshape), dtype=patch_dtype(
+        loader, [(p.view, p.level) for p in plans]))
     affines = np.zeros((vb, 3, 4), dtype=np.float32)
     offsets = np.zeros((vb, 3), dtype=np.float32)
     img_dims = np.ones((vb, 3), dtype=np.float32)
@@ -275,7 +277,8 @@ def _shift_inputs(loader, plans, block_global, bshape, vb, blend,
                   inside_offset):
     """Host-side input staging for the translation shifted-slice kernel."""
     pshape = tuple(s + 1 for s in bshape)
-    patches = np.zeros((vb, *pshape), dtype=_patch_dtype(loader, plans))
+    patches = np.zeros((vb, *pshape), dtype=patch_dtype(
+        loader, [(p.view, p.level) for p in plans]))
     fracs = np.zeros((vb, 3), dtype=np.float32)
     lpos0 = np.zeros((vb, 3), dtype=np.float32)
     img_dims = np.ones((vb, 3), dtype=np.float32)

@@ -330,7 +330,13 @@ def _plan_nonrigid_block(
 
 def _stage_nonrigid(loader, plans, pshape, vb, blend: BlendParams, gdims):
     """Host-side input staging for one block's nonrigid kernel inputs."""
-    patches = np.zeros((vb, *pshape), np.float32)
+    # stored integer dtype when every view shares one (<=16-bit): ships at
+    # native width, kernel casts to float32 on device (lossless — same
+    # memoized transport decision as the affine paths)
+    from .affine_fusion import patch_dtype
+
+    patches = np.zeros(
+        (vb, *pshape), patch_dtype(loader, [(v, 0) for v, *_ in plans]))
     grids = np.zeros((vb, *gdims, 12), np.float32)
     grids[..., 0] = 1.0
     grids[..., 5] = 1.0
@@ -343,9 +349,7 @@ def _stage_nonrigid(loader, plans, pshape, vb, blend: BlendParams, gdims):
     valid = np.zeros((vb,), np.float32)
     for i, (v, grid, inv_total, clipped, dim) in enumerate(plans):
         with profiling.span("nonrigid.prefetch"):
-            patches[i] = loader.read_block(
-                v, 0, tuple(clipped.min), pshape
-            ).astype(np.float32)
+            patches[i] = loader.read_block(v, 0, tuple(clipped.min), pshape)
         grids[i] = grid
         vaffines[i] = concatenate(
             translation_affine(-np.asarray(clipped.min, np.float64)), inv_total

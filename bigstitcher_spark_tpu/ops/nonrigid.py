@@ -118,6 +118,7 @@ def nonrigid_fuse_block_impl(
 ):
     """Fuse one output block under per-view non-rigid deformation.
     Returns (fused, weight-sum) blocks."""
+    patches = patches.astype(jnp.float32)  # lossless transport downcast
     def one(*args):
         return _sample_one_view_nonrigid(*args, block_shape=block_shape)
 
