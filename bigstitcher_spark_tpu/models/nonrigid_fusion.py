@@ -32,7 +32,9 @@ from ..utils.geometry import (
 )
 from ..utils.grid import GridBlock, create_grid
 from .. import profiling
-from .affine_fusion import BlendParams, FusionStats, anisotropy_transform
+from .affine_fusion import (
+    BlendParams, FusionStats, anisotropy_transform, patch_dtype,
+)
 
 FUSE_MARGIN = 50.0   # px margin for view selection (SparkNonRigidFusion.java:326-371)
 IP_MARGIN = 25.0     # px margin for deformation-defining points
@@ -333,8 +335,6 @@ def _stage_nonrigid(loader, plans, pshape, vb, blend: BlendParams, gdims):
     # stored integer dtype when every view shares one (<=16-bit): ships at
     # native width, kernel casts to float32 on device (lossless — same
     # memoized transport decision as the affine paths)
-    from .affine_fusion import patch_dtype
-
     patches = np.zeros(
         (vb, *pshape), patch_dtype(loader, [(v, 0) for v, *_ in plans]))
     grids = np.zeros((vb, *gdims, 12), np.float32)

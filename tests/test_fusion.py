@@ -432,6 +432,48 @@ class TestSeparableDiagonalKernel:
         assert vol.std() > 0
 
 
+class TestPatchDtype:
+    """The lossless transport decision: native integer width when every
+    (view, level) shares one, float32 otherwise; probes memoized on the
+    loader (models/affine_fusion.patch_dtype)."""
+
+    class _FakeLoader:
+        def __init__(self, dtypes):
+            self._dtypes = dtypes
+            self.opens = 0
+
+        def open(self, view, level):
+            self.opens += 1
+            import types
+            return types.SimpleNamespace(dtype=self._dtypes[(view, level)])
+
+    def test_uniform_uint16_and_memoization(self):
+        from bigstitcher_spark_tpu.models.affine_fusion import patch_dtype
+
+        ld = self._FakeLoader({("a", 0): np.uint16, ("b", 0): np.uint16})
+        assert patch_dtype(ld, [("a", 0), ("b", 0)]) == np.dtype(np.uint16)
+        n = ld.opens
+        assert patch_dtype(ld, [("a", 0), ("b", 0)]) == np.dtype(np.uint16)
+        assert ld.opens == n  # second call fully memoized
+
+    def test_mixed_or_wide_dtypes_fall_back_to_float32(self):
+        from bigstitcher_spark_tpu.models.affine_fusion import patch_dtype
+
+        mixed = self._FakeLoader({("a", 0): np.uint16, ("b", 0): np.uint8})
+        assert patch_dtype(mixed, [("a", 0), ("b", 0)]) == np.dtype(np.float32)
+        wide = self._FakeLoader({("a", 0): np.uint32})
+        assert patch_dtype(wide, [("a", 0)]) == np.dtype(np.float32)
+        flt = self._FakeLoader({("a", 0): np.float32})
+        assert patch_dtype(flt, [("a", 0)]) == np.dtype(np.float32)
+
+    def test_big_endian_normalized(self):
+        from bigstitcher_spark_tpu.models.affine_fusion import patch_dtype
+
+        ld = self._FakeLoader({("a", 0): np.dtype(">u2")})
+        d = patch_dtype(ld, [("a", 0)])
+        assert d == np.dtype(np.uint16) and d.byteorder in "=|<"
+
+
 class TestTpuLoweringSafety:
     def test_composite_kernel_lowers_scatter_free(self, tmp_path):
         """The composite fusion kernel must not emit HLO scatter ops:
