@@ -455,9 +455,9 @@ def _stitch_jobs(xml_path):
 
 def measure_phasecorr(xml_path):
     """TPU (or fallback-CPU XLA) pairs/sec on the same crops, steady state.
-    Uses the production ``stitch_jobs`` pipeline: all shape buckets'
-    device programs dispatch before host refinement starts, so refinement
-    of bucket k overlaps the FFTs of bucket k+1."""
+    Uses the production ``stitch_jobs`` pipeline: shape buckets group into
+    memory-bounded segments, each drained by ONE pipelined fetch, with
+    host refinement of segment k overlapping the device FFTs of k+1."""
     from bigstitcher_spark_tpu.models.stitching import stitch_jobs
 
     sd, jobs, params = _stitch_jobs(xml_path)
