@@ -406,10 +406,30 @@ def _spans_snapshot():
             for k, s in profiling.get().stats().items()}
 
 
+def _io_baseline():
+    """Snapshot of the shared observe.metrics registry (the same registry
+    the production drivers feed — chunk IO bytes by implementation path,
+    h2d/d2h transfer bytes), for per-run deltas."""
+    from bigstitcher_spark_tpu.observe import metrics
+
+    return metrics.get_registry().snapshot()
+
+
+def _io_snapshot(baseline):
+    """This run's IO/transfer byte deltas (registry counters that moved)."""
+    from bigstitcher_spark_tpu.observe import metrics
+
+    delta = metrics.get_registry().snapshot_delta(baseline)
+    return {k: int(v) for k, v in delta.items()
+            if k.startswith(("bst_io_", "bst_xfer_"))
+            and isinstance(v, (int, float)) and v}
+
+
 def _best_timed(n, fn):
     """Run ``fn`` n times under span profiling; return (best_dt, result,
-    spans) of the fastest run (same span schema as the fusion measure).
-    Profiling is always disabled on exit, even if ``fn`` raises.
+    spans, io) of the fastest run (same span schema as the fusion measure;
+    ``io`` is the run's observe.metrics byte-counter delta). Profiling is
+    always disabled on exit, even if ``fn`` raises.
 
     The CPU baselines run unprofiled; the asymmetry is accepted because the
     recorder costs one mutex + clock read per span and these runs have only
@@ -418,19 +438,21 @@ def _best_timed(n, fn):
     fusion measure's existing behavior."""
     from bigstitcher_spark_tpu import profiling
 
-    best_dt, best_res, spans = float("inf"), None, {}
+    best_dt, best_res, spans, io = float("inf"), None, {}, {}
     try:
         for _ in range(n):
             profiling.enable(True)
             profiling.get().reset()
+            iob = _io_baseline()
             t0 = time.time()
             res = fn()
             dt = time.time() - t0
             if dt < best_dt:
                 best_dt, best_res, spans = dt, res, _spans_snapshot()
+                io = _io_snapshot(iob)
     finally:
         profiling.enable(False)
-    return best_dt, best_res, spans
+    return best_dt, best_res, spans, io
 
 
 def _stitch_jobs(xml_path):
@@ -464,7 +486,8 @@ def measure_phasecorr(xml_path):
 
     stitch_jobs(sd, jobs, params)  # compile
     # best-of-3, matching the baseline's treatment
-    dt, results, spans = _best_timed(3, lambda: stitch_jobs(sd, jobs, params))
+    dt, results, spans, io = _best_timed(
+        3, lambda: stitch_jobs(sd, jobs, params))
     cpu = measure_phasecorr_baseline(jobs)
     return {
         "metric": "phasecorr_pairs_per_sec",
@@ -474,6 +497,7 @@ def measure_phasecorr(xml_path):
         "vs_baseline": round(len(results) / dt / cpu, 3),
         "baseline_pairs_per_sec": round(cpu, 3),
         "spans": spans,
+        "io": io,
     }
 
 
@@ -660,7 +684,7 @@ def measure_dog(xml_path):
         for v in views)
     detect_interest_points(sd, loader, views, params, progress=False)  # warm
     # best-of-3, matching the baseline's treatment
-    dt, dets, spans = _best_timed(
+    dt, dets, spans, io = _best_timed(
         3, lambda: detect_interest_points(sd, loader, views, params,
                                           progress=False))
     cpu = measure_dog_baseline(xml_path)
@@ -673,6 +697,7 @@ def measure_dog(xml_path):
         "vs_baseline": round(total_vox / dt / cpu, 3),
         "baseline_vox_per_sec": round(cpu, 1),
         "spans": spans,
+        "io": io,
     }
 
 
@@ -882,7 +907,8 @@ def measure_multitp():
         return ds
 
     run()  # warm compiles
-    dt, ds, spans = _best_timed(1, run)  # single timed run, span-profiled
+    # single timed run, span-profiled
+    dt, ds, spans, io = _best_timed(1, run)
     vox = int(np.prod(bbox.shape)) * n_ch * n_tp
 
     # baseline: the same numpy fusion per slot (cached)
@@ -929,6 +955,7 @@ def measure_multitp():
         "vs_baseline": round(vox / dt / base, 3),
         "baseline_vox_per_sec": round(base, 1),
         "spans": spans,
+        "io": io,
     }
 
 
@@ -1064,7 +1091,8 @@ def measure_nonrigid():
         return ds
 
     run()  # warm compiles
-    dt, ds, spans = _best_timed(1, run)  # single timed run, span-profiled
+    # single timed run, span-profiled
+    dt, ds, spans, io = _best_timed(1, run)
     vox = int(np.prod(bbox.shape))
 
     cache = _baseline_cache_load()
@@ -1104,6 +1132,7 @@ def measure_nonrigid():
         "vs_baseline": round(vox / dt / base, 3),
         "baseline_vox_per_sec": round(base, 1),
         "spans": spans,
+        "io": io,
     }
 
 
@@ -1222,7 +1251,7 @@ def _validate_fusion(xml, ds):
 
 
 def _primary_result(vox_per_sec, baseline, platform, spans,
-                    runs_done=FUSION_RUNS):
+                    runs_done=FUSION_RUNS, io=None):
     res = {
         "metric": "affine_fusion_voxels_per_sec",
         "value": round(vox_per_sec, 1),
@@ -1235,6 +1264,7 @@ def _primary_result(vox_per_sec, baseline, platform, spans,
             "history in BASELINE_MEASURED.json"),
         "best_of_runs": runs_done,
         "spans": spans,
+        "io": io or {},
         "extra_metrics": [],
     }
     if platform not in ("cpu",):
@@ -1306,6 +1336,15 @@ def _finalize(result, truncated=None):
     if truncated:
         result["truncated"] = truncated
         _log(f"finalizing early: {truncated}")
+    try:  # BST_TELEMETRY_DIR runs also leave a manifest + metrics textfile
+        from bigstitcher_spark_tpu import observe
+
+        observe.finalize(tool="bench",
+                         params={"platform": result.get("platform"),
+                                 "truncated": truncated},
+                         status="truncated" if truncated else "ok")
+    except Exception as e:  # telemetry must never void the artifact
+        _log(f"telemetry finalize failed: {e!r}")
     drift = _baseline_drift_flags()
     if drift:
         result["baseline_drift_flags"] = drift
@@ -1330,6 +1369,12 @@ EXTRA_MEASURES = (
 
 def child_main():
     _log("child start")
+    if os.environ.get("BST_TELEMETRY_DIR"):
+        from bigstitcher_spark_tpu import observe
+
+        # same registry/event/manifest path as `bst ... --telemetry-dir`;
+        # profiling stays under the bench's own enable/reset control
+        observe.configure(os.environ["BST_TELEMETRY_DIR"], profile=False)
     xml = build_fixture()
     _log("fixture ready")
     out = os.path.join(FIXTURE, "fused.ome.zarr")
@@ -1352,12 +1397,14 @@ def child_main():
     platform = jax.devices()[0].platform
     best_v = 0.0
     best_spans = {}
+    best_io = {}
     validated = False
     runs_done = 0
     try:
         for i in range(FUSION_RUNS):
             profiling.enable(True)
             profiling.get().reset()
+            iob = _io_baseline()
             try:
                 stats, ds, bbox = _run_with_watchdog(
                     lambda: run_fusion(xml, out))
@@ -1368,7 +1415,8 @@ def child_main():
                 # completed validated runs survive the stall: finalize now
                 # instead of burning the rest of the child time budget
                 _finalize(_primary_result(best_v, baseline, platform,
-                                          best_spans, runs_done=runs_done),
+                                          best_spans, runs_done=runs_done,
+                                          io=best_io),
                           truncated=f"fusion run {i + 1}: {e}")
             v = stats.voxels / max(stats.seconds, 1e-9)
             runs_done = i + 1
@@ -1376,6 +1424,7 @@ def child_main():
                  f"({stats.seconds:.2f}s)")
             if v > best_v:
                 best_v, best_spans = v, _spans_snapshot()
+                best_io = _io_snapshot(iob)
             profiling.enable(False)
             if not validated:
                 _validate_fusion(xml, ds)
@@ -1385,10 +1434,12 @@ def child_main():
             # void the completed, validated runs (observed: attempt hung on
             # run 5/5 with four good runs that would otherwise be lost)
             _checkpoint(_primary_result(best_v, baseline, platform,
-                                        best_spans, runs_done=runs_done))
+                                        best_spans, runs_done=runs_done,
+                                        io=best_io))
     finally:
         profiling.enable(False)
-    result = _primary_result(best_v, baseline, platform, best_spans)
+    result = _primary_result(best_v, baseline, platform, best_spans,
+                             io=best_io)
     _checkpoint(result)
     for name, fn in EXTRA_MEASURES:
         try:

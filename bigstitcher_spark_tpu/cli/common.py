@@ -17,13 +17,75 @@ def _set_s3_region(ctx, param, value):
     return value
 
 
+def _register_telemetry_close(ctx):
+    """Finalize telemetry exactly once when the command's context closes
+    (ctx.params is fully resolved by then, so the manifest records the
+    command's actual configuration)."""
+    if ctx.meta.get("bst.telemetry.registered"):
+        return
+    ctx.meta["bst.telemetry.registered"] = True
+
+    def _close():
+        import sys
+
+        from .. import observe, profiling
+
+        # during unwinding from a command error, the in-flight exception is
+        # the active one — best-effort status for the manifest
+        err = sys.exc_info()[1]
+        report = (profiling.get().report()
+                  if ctx.meta.get("bst.telemetry.profile") else None)
+        if observe.active():
+            observe.finalize(
+                tool=ctx.info_name, params=ctx.params,
+                status="error" if err is not None else "ok",
+                error=repr(err) if err is not None else None)
+        if report is not None:
+            click.echo(f"[profile]\n{report}", err=True)
+            profiling.enable(False)
+
+    ctx.call_on_close(_close)
+
+
+def _set_telemetry_dir(ctx, param, value):
+    if value:
+        from .. import observe
+
+        observe.configure(value)
+        _register_telemetry_close(ctx)
+    return value
+
+
+def _set_profile(ctx, param, value):
+    if value:
+        from .. import profiling
+
+        profiling.enable(True)
+        ctx.meta["bst.telemetry.profile"] = True
+        _register_telemetry_close(ctx)
+    return value
+
+
 def infrastructure_options(f):
-    """--dryRun / --s3Region (AbstractInfrastructure.java:14-27)."""
+    """--dryRun / --s3Region (AbstractInfrastructure.java:14-27) plus the
+    shared observability switches every tool inherits: --telemetry-dir
+    activates the event log / metrics textfile / run manifest
+    (observe package), --profile prints the span-stat table at exit."""
     f = click.option("--dryRun", "dry_run", is_flag=True, default=False,
                      help="compute but do not persist results")(f)
     f = click.option("--s3Region", "s3_region", default=None,
                      expose_value=False, callback=_set_s3_region,
                      help="AWS region for s3:// storage roots")(f)
+    f = click.option("--telemetry-dir", "telemetry_dir", default=None,
+                     expose_value=False, callback=_set_telemetry_dir,
+                     help="write a JSONL event log, Prometheus metrics "
+                          "textfile and run manifest into this directory "
+                          "(one file set per process; merge pod runs with "
+                          "'bst telemetry-merge')")(f)
+    f = click.option("--profile", is_flag=True, default=False,
+                     expose_value=False, callback=_set_profile,
+                     help="record per-span wall-clock aggregates and print "
+                          "the span table on exit")(f)
     return f
 
 

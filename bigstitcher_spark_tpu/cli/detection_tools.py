@@ -89,14 +89,14 @@ def detect_interestpoints_cmd(xml, dry_run, **kw):
     loader = ViewLoader(sd)
     detections = detect_interest_points(sd, loader, views, params)
     total = sum(len(d.points) for d in detections)
-    print(f"detected {total} interest points over {len(detections)} views")
+    click.echo(f"detected {total} interest points over {len(detections)} views")
     if dry_run:
-        print("dryRun: not saving")
+        click.echo("dryRun: not saving")
         return
     store = InterestPointStore.for_project(sd)
     save_detections(sd, store, detections, params)
     sd.save(xml)
-    print(f"saved interest points '{params.label}' + XML")
+    click.echo(f"saved interest points '{params.label}' + XML")
 
 
 @click.command()
@@ -212,9 +212,9 @@ def match_interestpoints_cmd(xml, dry_run, **kw):
     store = InterestPointStore.for_project(sd)
     results = match_interest_points(sd, views, params, store)
     total = sum(len(r.ids_a) for r in results)
-    print(f"matched {total} correspondences over {len(results)} pairs")
+    click.echo(f"matched {total} correspondences over {len(results)} pairs")
     if dry_run:
-        print("dryRun: not saving")
+        click.echo("dryRun: not saving")
         return
     save_matches(sd, store, results, params, views)
-    print("saved correspondences")
+    click.echo("saved correspondences")

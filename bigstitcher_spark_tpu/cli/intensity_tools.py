@@ -84,14 +84,14 @@ def match_intensities_cmd(xml, dry_run, coefficients, render_scale, method,
         max_trust=max_trust,
     )
     matches = match_intensities(sd, loader, views, params)
-    print(f"matched {len(matches)} coefficient-cell pairs")
+    click.echo(f"matched {len(matches)} coefficient-cell pairs")
     if dry_run:
-        print("dryRun: not saving")
+        click.echo("dryRun: not saving")
         return
     store = (IntensityStore(intensity_n5) if intensity_n5
              else IntensityStore.for_project(sd))
     store.save_matches(matches, params.coefficients)
-    print(f"saved matches to {store.root}")
+    click.echo(f"saved matches to {store.root}")
 
 
 @click.command()
@@ -153,13 +153,13 @@ def solve_intensities_cmd(xml, dry_run, lam, num_coefficients, matches_path,
     coeffs = solve_intensities(matches, views, dims, lam)
     if dry_run:
         for v, c in sorted(coeffs.items()):
-            print(f"  {v}: scale [{c[..., 0].min():.3f}, {c[..., 0].max():.3f}]"
+            click.echo(f"  {v}: scale [{c[..., 0].min():.3f}, {c[..., 0].max():.3f}]"
                   f" offset [{c[..., 1].min():.1f}, {c[..., 1].max():.1f}]")
-        print("dryRun: not saving")
+        click.echo("dryRun: not saving")
         return
     out_store = (IntensityStore(intensity_n5)
                  if intensity_n5 and intensity_n5 != match_root else store)
     for v, c in coeffs.items():
         out_store.save_coefficients(v, c, group=intensity_group,
                                     dataset=intensity_dataset)
-    print(f"saved coefficients for {len(coeffs)} views to {out_store.root}")
+    click.echo(f"saved coefficients for {len(coeffs)} views to {out_store.root}")

@@ -16,6 +16,7 @@ from . import (
     resave_tools,
     solver_tools,
     stitching_tools,
+    telemetry_tools,
     utility_tools,
 )
 
@@ -49,6 +50,7 @@ cli.add_command(utility_tools.inspect_interestpoints_cmd, "inspect-interestpoint
 cli.add_command(utility_tools.map_setup_ids_cmd, "map-setup-ids")
 cli.add_command(utility_tools.env_cmd, "env")
 cli.add_command(utility_tools.serve_container_cmd, "serve-container")
+cli.add_command(telemetry_tools.telemetry_merge_cmd, "telemetry-merge")
 
 
 def main():

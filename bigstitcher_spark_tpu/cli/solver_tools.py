@@ -83,11 +83,11 @@ def solver_cmd(xml, dry_run, source, labels, label_weights, method, model,
     )
     result = S.solve(sd, views, params)
     for key, corr in sorted(result.corrections.items()):
-        print(f"  {key[0]}{'+' + str(len(key) - 1) if len(key) > 1 else ''}: "
+        click.echo(f"  {key[0]}{'+' + str(len(key) - 1) if len(key) > 1 else ''}: "
               f"t={np.round(corr[:, 3], 3)}")
     if dry_run:
-        print("dryRun: not saving XML")
+        click.echo("dryRun: not saving XML")
         return
     S.store_corrections(sd, result, params)
     sd.save()
-    print(f"saved {xml}")
+    click.echo(f"saved {xml}")

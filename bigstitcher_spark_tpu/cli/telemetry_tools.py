@@ -1,0 +1,89 @@
+"""``telemetry-merge`` — fold a pod run's per-process telemetry files.
+
+No reference counterpart (Spark's history server renders the merged view
+of its event logs); here N per-process ``manifest-*.json`` /
+``events-*.jsonl`` file sets written by ``--telemetry-dir`` fold into one
+``merged-report.json`` plus a console summary: per-process status, the
+summed metric/byte totals, the merged span table and per-stage
+throughput.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import click
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+@click.command()
+@click.argument("telemetry_dir",
+                type=click.Path(exists=True, file_okay=False))
+@click.option("-o", "--output", "output", default=None,
+              help="merged report path (default: "
+                   "<telemetry_dir>/merged-report.json)")
+def telemetry_merge_cmd(telemetry_dir, output):
+    """Merge per-process telemetry files into one run report."""
+    from ..observe.manifest import merge_run
+
+    try:
+        report = merge_run(telemetry_dir)
+    except FileNotFoundError as e:
+        raise click.ClickException(str(e)) from e
+    out = output or os.path.join(telemetry_dir, "merged-report.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, default=str)
+        f.write("\n")
+
+    procs = report["processes"]
+    click.echo(f"run: {len(procs)} manifest(s), "
+               f"{report['process_count']} process(es), "
+               f"wall clock {report['wall_clock_s']}s, "
+               f"{report['events']} events")
+    for p in procs:
+        dev = p.get("device", {})
+        click.echo(f"  [{p.get('process_index')}] {p.get('tool')} "
+                   f"{p.get('status')} in {p.get('seconds')}s "
+                   f"({dev.get('platform', '?')} x"
+                   f"{dev.get('local_device_count', '?')})")
+    if report["stages"]:
+        click.echo("stages:")
+        for s in report["stages"]:
+            rate = s.get("rate_per_s")
+            eta = s.get("eta_error_s")
+            secs = s.get("seconds")
+            secs = round(secs, 3) if isinstance(secs, float) else secs
+            click.echo(
+                f"  {s['stage']}: {s.get('done', '?')}/{s.get('total', '?')} "
+                f"items in {secs}s"
+                + (f" ({round(rate, 3)}/s)" if rate is not None else "")
+                + (f", ETA error {eta:+.1f}s" if eta is not None else ""))
+    m = report["metrics"]
+
+    def _total(prefix):
+        return sum(v for k, v in m.items()
+                   if k.startswith(prefix) and isinstance(v, (int, float)))
+
+    click.echo(
+        "io: read "
+        f"{_fmt_bytes(_total('bst_io_read_bytes_total'))}, write "
+        f"{_fmt_bytes(_total('bst_io_write_bytes_total'))}, h2d "
+        f"{_fmt_bytes(_total('bst_xfer_h2d_bytes_total'))}, d2h "
+        f"{_fmt_bytes(_total('bst_xfer_d2h_bytes_total'))}")
+    if report["failures_by_exception"]:
+        click.echo("failures by exception: " + ", ".join(
+            f"{k} x{v}" for k, v in
+            sorted(report["failures_by_exception"].items(),
+                   key=lambda kv: -kv[1])))
+    retries = _total("bst_retry_rounds_total")
+    if retries:
+        click.echo(f"retry rounds: {int(retries)}")
+    click.echo(f"merged report -> {out}")

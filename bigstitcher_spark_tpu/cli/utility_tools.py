@@ -41,7 +41,7 @@ def clear_interestpoints_cmd(xml, dry_run, label, only_corrs, **kw):
             if lab not in sd.interest_points.get(v, {}):
                 continue
             if dry_run:
-                print(f"would clear {v} label {lab!r}")
+                click.echo(f"would clear {v} label {lab!r}")
                 continue
             if only_corrs:
                 store.clear_correspondences(v, lab)
@@ -52,7 +52,7 @@ def clear_interestpoints_cmd(xml, dry_run, label, only_corrs, **kw):
                     del sd.interest_points[v]
             n += 1
     what = "correspondences" if only_corrs else "interest points"
-    print(f"cleared {what} of {n} (view, label) entries")
+    click.echo(f"cleared {what} of {n} (view, label) entries")
     if not dry_run:
         sd.save(xml)
 
@@ -85,11 +85,11 @@ def clear_registrations_cmd(xml, dry_run, keep, remove, **kw):
         else:
             drop = chain[: max(len(chain) - keep, 0)]
         for t in drop:
-            print(f"{v}: removing {t.name!r}")
+            click.echo(f"{v}: removing {t.name!r}")
         sd.registrations[v] = chain[len(drop):]
     if not dry_run:
         sd.save(xml)
-        print("saved XML")
+        click.echo("saved XML")
 
 
 @click.command()
@@ -131,10 +131,10 @@ def transform_points_cmd(xml, dry_run, vi, points, csv_in, csv_out):
     if csv_out and not dry_run:
         with open(csv_out, "w") as f:
             f.write("\n".join(lines) + "\n")
-        print(f"wrote {len(lines)} transformed points to {csv_out}")
+        click.echo(f"wrote {len(lines)} transformed points to {csv_out}")
     else:
         for src, dst in zip(pts, lines):
-            print(f"{tuple(src)} -> {dst}")
+            click.echo(f"{tuple(src)} -> {dst}")
 
 
 @click.command()
@@ -193,16 +193,16 @@ def split_images_cmd(xml, dry_run, xml_out, target_size, target_overlap,
         for sid in sorted(new_sd.setups):
             su = new_sd.setups[sid]
             src = new_sd.split_info.get(sid)
-            print(f"  setup {sid}: size {su.size}"
+            click.echo(f"  setup {sid}: size {su.size}"
                   + (f" <- source setup {src[0]} @ offset {tuple(src[1])}"
                      if src is not None else ""))
-    print(f"split {len(sd.setups)} setups into {len(new_sd.setups)} sub-views")
+    click.echo(f"split {len(sd.setups)} setups into {len(new_sd.setups)} sub-views")
     if dry_run:
-        print("dryRun: not saving")
+        click.echo("dryRun: not saving")
         return
     out = xml_out or xml
     new_sd.save(out)
-    print(f"saved {out}")
+    click.echo(f"saved {out}")
 
 
 @click.command()

@@ -26,6 +26,27 @@ import numpy as np
 import tensorstore as ts
 
 from . import uris
+from ..observe import events as _events
+from ..observe import metrics as _metrics
+
+# one (bytes, chunk-ops) counter pair per (op, path-taken) — cached so the
+# hot path pays one dict lookup + two lock'd adds per box read/write, which
+# also records WHICH implementation served it (native codec vs tensorstore
+# vs h5py), the tuning signal for the native-IO fast paths
+_IO_COUNTERS: dict[tuple[str, str], tuple] = {}
+
+
+def _record_io(op: str, via: str, nbytes: int, dataset: str) -> None:
+    pair = _IO_COUNTERS.get((op, via))
+    if pair is None:
+        pair = (_metrics.counter(f"bst_io_{op}_bytes_total", path=via),
+                _metrics.counter(f"bst_io_{op}_ops_total", path=via))
+        _IO_COUNTERS[(op, via)] = pair
+    pair[0].inc(int(nbytes))
+    pair[1].inc()
+    if _events.enabled():
+        _events.emit(f"io.{op}", path=via, bytes=int(nbytes),
+                     dataset=dataset)
 
 # one shared Context so every open in this process sees the same caches and
 # the same in-process ``memory://`` store (tensorstore scopes the memory
@@ -183,6 +204,7 @@ class Dataset:
         """Read a box (xyz-first offset/shape) into a numpy array (xyz-first)."""
         native = self._native_read(offset, shape)
         if native is not None:
+            _record_io("read", "native", native.nbytes, self.path)
             return native
         if self._ts is None:
             raise ValueError(
@@ -191,9 +213,12 @@ class Dataset:
         sel = self._sel(offset, shape)
         if hasattr(self._ts, "read"):
             data = self._ts[sel].read().result()
+            via = "tensorstore"
         else:
             data = self._ts[sel]
+            via = "h5py"
         data = np.asarray(data)
+        _record_io("read", via, data.nbytes, self.path)
         return data.transpose(tuple(range(data.ndim))[::-1]) if self.reversed_axes else data
 
     def _native_read(self, offset: Sequence[int],
@@ -268,6 +293,7 @@ class Dataset:
         (GIL-free strided copy + zstd encode + file write,
         io.native_blockio) when available."""
         if self._native_write(data, offset) or self._native_write_zarr(data, offset):
+            _record_io("write", "native", data.nbytes, self.path)
             return
         if self._ts is None:
             raise ValueError(
@@ -278,8 +304,11 @@ class Dataset:
             data = data.transpose(tuple(range(data.ndim))[::-1])
         if hasattr(self._ts, "read"):
             self._ts[sel].write(np.ascontiguousarray(data)).result()
+            via = "tensorstore"
         else:
             self._ts[sel] = data
+            via = "h5py"
+        _record_io("write", via, data.nbytes, self.path)
 
     def _native_n5_eligible(self) -> str | None:
         """Shared native-codec eligibility gate for N5 reads AND writes:

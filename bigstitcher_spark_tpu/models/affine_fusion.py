@@ -29,7 +29,11 @@ from ..utils.geometry import (
     transformed_interval,
 )
 from ..utils.grid import GridBlock, create_grid
-from .. import profiling
+from .. import observe, profiling
+from ..observe import metrics as _metrics
+
+_H2D_BYTES = _metrics.counter("bst_xfer_h2d_bytes_total")
+_D2H_BYTES = _metrics.counter("bst_xfer_d2h_bytes_total")
 
 
 @dataclass
@@ -477,8 +481,10 @@ def upload_composite_tiles(loader, cp: CompositePlan) -> list:
     import jax
 
     with profiling.span("fusion.h2d_tiles"):
-        return [jax.device_put(loader.open(p.view, 0).read_full())
-                for p in cp.plans]
+        tiles = [jax.device_put(loader.open(p.view, 0).read_full())
+                 for p in cp.plans]
+        _H2D_BYTES.inc(sum(int(t.nbytes) for t in tiles))
+        return tiles
 
 
 def dispatch_composite(cp: CompositePlan, tiles, fusion_type, out_dtype,
@@ -561,6 +567,7 @@ def _drain_device_volume(out, out_ds, zarr_ct, io_threads=4):
         x0, slab = item
         with profiling.span("fusion.d2h"):
             data = np.asarray(slab)
+            _D2H_BYTES.inc(data.nbytes)
         with profiling.span("fusion.write"):
             if zarr_ct is not None:
                 c, t = zarr_ct
@@ -700,6 +707,26 @@ def _fuse_volume_sharded(
         pool.shutdown(wait=True)
 
 
+def _record_fusion_stage(stage: str, stats: "FusionStats",
+                         path_kind: str) -> None:
+    """File the driver's end-of-stage summary with the telemetry layer
+    (block/voxel totals the reference reads off the Spark UI)."""
+    observe.progress.record_stage(
+        stage,
+        done=stats.blocks - stats.skipped_empty,
+        total=stats.blocks,
+        blocks=stats.blocks,
+        skipped_empty=stats.skipped_empty,
+        voxels=stats.voxels,
+        seconds=round(stats.seconds, 3),
+        rate_per_s=round((stats.blocks - stats.skipped_empty)
+                         / max(stats.seconds, 1e-9), 3),
+        voxels_per_s=round(stats.voxels / max(stats.seconds, 1e-9), 1),
+        compile_keys=len(stats.compile_keys),
+        path=path_kind,
+    )
+
+
 def fuse_volume(
     sd: SpimData,
     loader: ViewLoader,
@@ -756,6 +783,7 @@ def fuse_volume(
             n_dev, io_threads, progress,
         )
         stats.seconds = time.time() - t0
+        _record_fusion_stage("affine-fusion", stats, "sharded")
         return stats
 
     # multi-host with one local device: each process takes its slice of the
@@ -780,6 +808,7 @@ def fuse_volume(
         stats.blocks = len(grid)
         stats.voxels = bbox.num_elements
         stats.seconds = time.time() - t0
+        _record_fusion_stage("affine-fusion", stats, "composite")
         return stats
 
     def process(block: GridBlock) -> None:
@@ -815,10 +844,12 @@ def fuse_volume(
                 out_ds.write(data, block.offset)
         stats.voxels += int(np.prod(block.size))
         if progress:
-            print(f"  block {block.offset} done ({len(grid)} total)")
+            observe.log(f"  block {block.offset} done ({len(grid)} total)",
+                        stage="affine-fusion")
 
     from ..parallel.retry import run_with_retry
 
     run_with_retry(grid, process, label="fusion block")
     stats.seconds = time.time() - t0
+    _record_fusion_stage("affine-fusion", stats, "per-block")
     return stats

@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from .. import observe
 from ..io.dataset_io import ViewLoader, best_mipmap_level, mipmap_transform
 from ..io.interestpoints import InterestPointStore, register_points_in_xml
 from ..io.spimdata import SpimData, ViewId
@@ -346,9 +347,10 @@ def detect_interest_points(
                 continue
             jobs.append(_BlockJob(vi, core))
 
-    if progress:
-        print(f"detection: {len(view_list)} views, {len(jobs)} blocks "
-              f"(block {bs}, halo {halo}, ds {ds})")
+    observe.log(f"detection: {len(view_list)} views, {len(jobs)} blocks "
+                f"(block {bs}, halo {halo}, ds {ds})",
+                stage="detection", echo=progress,
+                views=len(view_list), blocks=len(jobs))
 
     # bucket by block shape (edge blocks are smaller) -> one compiled kernel
     # per shape bucket; the bucket's block list is batched over the device
@@ -460,8 +462,8 @@ def detect_interest_points(
         if params.store_intensities and len(pts):
             det.intensities = _sample_intensities(loader, plan, pts)
         out.append(det)
-        if progress:
-            print(f"  {v}: {len(full)} interest points")
+        observe.log(f"  {v}: {len(full)} interest points",
+                    stage="detection", echo=progress, points=len(full))
     return out
 
 

@@ -143,6 +143,11 @@ def downsample_pyramid_level(
 ) -> None:
     """Fill ``dst_info`` from ``src_info`` by relative-factor averaging,
     block-sharded over the device mesh (SparkDownsample.java:141-177)."""
+    import time
+
+    from .. import observe
+
+    t0 = time.time()
     src = store.open_dataset(src_info.dataset.strip("/"))
     dst = store.open_dataset(dst_info.dataset.strip("/"))
     rel = [int(v) for v in dst_info.relativeDownsampling[:3]]
@@ -173,3 +178,9 @@ def downsample_pyramid_level(
 
     run_sharded_downsample(grid, read_job, write_job, rel, devices=devices,
                            io_threads=io_threads)
+    dt = time.time() - t0
+    observe.progress.record_stage(
+        f"downsample {dst_info.dataset.strip('/')}",
+        done=len(grid), blocks=len(grid), seconds=round(dt, 3),
+        rate_per_s=round(len(grid) / max(dt, 1e-9), 3),
+    )

@@ -18,6 +18,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from .. import observe
 from ..io.chunkstore import ChunkStore, StorageFormat
 from ..io.dataset_io import ViewLoader, best_mipmap_level
 from ..io.spimdata import SpimData, ViewId
@@ -218,8 +219,9 @@ def match_intensities(
             m = match_pair_intensities(sd, loader, va, vb, params, seed=5 + k)
             k += 1
             matches.extend(m)
-            if progress:
-                print(f"  {va} <-> {vb}: {len(m)} cell matches")
+            observe.log(f"  {va} <-> {vb}: {len(m)} cell matches",
+                        stage="match-intensities", echo=progress,
+                        matches=len(m))
     return matches
 
 
@@ -336,9 +338,10 @@ def solve_intensities(
             continue
         stats_rows.append((base[m.view_a] + m.cell_a,
                            base[m.view_b] + m.cell_b, *m.stats))
-    if progress:
-        print(f"solve-intensities: {len(views)} views x {ncell} cells, "
-              f"{len(stats_rows)} matches, λ={lam}")
+    observe.log(f"solve-intensities: {len(views)} views x {ncell} cells, "
+                f"{len(stats_rows)} matches, λ={lam}",
+                stage="solve-intensities", echo=progress,
+                views=len(views), cells=ncell, matches=len(stats_rows))
     # intensities can be large (uint16): normalize the quadratic form by the
     # global mean intensity so lam is scale-free
     mean_i = (np.mean([r[3] / max(r[2], 1) for r in stats_rows])

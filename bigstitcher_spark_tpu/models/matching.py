@@ -29,7 +29,7 @@ from ..io.spimdata import SpimData, ViewId
 from ..ops import descriptors as D
 from ..ops import models as M
 from ..utils.geometry import Interval, apply_affine, transformed_interval
-from .. import profiling
+from .. import observe, profiling
 
 INDIVIDUAL_TIMEPOINTS = "TIMEPOINTS_INDIVIDUALLY"
 ALL_TO_ALL = "ALL_TO_ALL"
@@ -327,9 +327,10 @@ def _match_grouped(
     (SparkGeometricDescriptorMatching.java:343-503)."""
     groups = build_match_groups(sd, views, params)
     pairs = plan_group_pairs(sd, groups, params)
-    if progress:
-        print(f"matching (grouped): {len(groups)} groups, {len(pairs)} group "
-              f"pairs, merge distance {params.merge_distance}")
+    observe.log(f"matching (grouped): {len(groups)} groups, {len(pairs)} "
+                f"group pairs, merge distance {params.merge_distance}",
+                stage="matching", echo=progress,
+                groups=len(groups), pairs=len(pairs))
 
     cache: dict[ViewId, tuple[np.ndarray, np.ndarray]] = {}
 
@@ -372,9 +373,10 @@ def _match_grouped(
             vb_of, ids_b, wb = vb_of[kb], ids_b[kb], wb[kb]
         with profiling.span("matching.group_pair"):
             inl, model, n_cand = match_pair(wa, wb, params, seed=17 + k)
-        if progress:
-            print(f"  group {ga[0]}x{len(ga)} <-> {gb[0]}x{len(gb)}: "
-                  f"{len(inl)} inliers / {n_cand} candidates")
+        observe.log(f"  group {ga[0]}x{len(ga)} <-> {gb[0]}x{len(gb)}: "
+                    f"{len(inl)} inliers / {n_cand} candidates",
+                    stage="matching", echo=progress,
+                    inliers=len(inl), candidates=n_cand)
         # split grouped inliers per original (viewA, viewB) pair
         per_pair: dict[tuple[ViewId, ViewId], list[tuple[int, int]]] = {}
         for ia, ib in inl:
@@ -382,16 +384,18 @@ def _match_grouped(
             per_pair.setdefault(pair, []).append((int(ids_a[ia]), int(ids_b[ib])))
         for (va, vb), id_pairs in sorted(per_pair.items()):
             if len(id_pairs) < min_matches:
-                if progress:
-                    print(f"    {va} <-> {vb}: {len(id_pairs)} correspondences "
-                          "(omitted: fewer than the model minimum)")
+                observe.log(f"    {va} <-> {vb}: {len(id_pairs)} "
+                            "correspondences (omitted: fewer than the model "
+                            "minimum)", stage="matching", echo=progress,
+                            correspondences=len(id_pairs), omitted=True)
                 continue
             arr = np.array(id_pairs, np.uint64)
             results.append(PairMatchResult(
                 va, vb, arr[:, 0], arr[:, 1], model, n_cand,
                 label_a=params.label, label_b=params.label))
-            if progress:
-                print(f"    {va} <-> {vb}: {len(id_pairs)} correspondences")
+            observe.log(f"    {va} <-> {vb}: {len(id_pairs)} correspondences",
+                        stage="matching", echo=progress,
+                        correspondences=len(id_pairs))
     return results
 
 
@@ -414,9 +418,10 @@ def match_interest_points(
                 "run ungrouped for multi-label / --matchAcrossLabels")
         return _match_grouped(sd, views, params, store, progress)
     pairs = plan_match_pairs(sd, views, params)
-    if progress:
-        print(f"matching: {len(pairs)} view pairs, method {params.method}, "
-              f"model {params.model} reg {params.regularization} λ={params.lam}")
+    observe.log(f"matching: {len(pairs)} view pairs, method {params.method}, "
+                f"model {params.model} reg {params.regularization} "
+                f"λ={params.lam}", stage="matching", echo=progress,
+                pairs=len(pairs), method=str(params.method))
 
     cache: dict[tuple, tuple[np.ndarray, np.ndarray]] = {}
 
@@ -446,8 +451,9 @@ def match_interest_points(
             model, n_cand, label_a=la, label_b=lb,
         )
         results.append(res)
-        if progress:
-            print(f"  {va} <-> {vb}: {len(inl)} inliers / {n_cand} candidates")
+        observe.log(f"  {va} <-> {vb}: {len(inl)} inliers / {n_cand} "
+                    "candidates", stage="matching", echo=progress,
+                    inliers=len(inl), candidates=n_cand)
     return results
 
 

@@ -33,7 +33,8 @@ from ..utils.geometry import (
 from ..utils.grid import GridBlock, create_grid
 from .. import profiling
 from .affine_fusion import (
-    BlendParams, FusionStats, anisotropy_transform, patch_dtype,
+    BlendParams, FusionStats, _record_fusion_stage, anisotropy_transform,
+    patch_dtype,
 )
 
 FUSE_MARGIN = 50.0   # px margin for view selection (SparkNonRigidFusion.java:326-371)
@@ -241,6 +242,7 @@ def fuse_nonrigid_volume(
     finally:
         pool.shutdown(wait=True)
     stats.seconds = time.time() - t0
+    _record_fusion_stage("nonrigid-fusion", stats, "sharded")
     return stats
 
 

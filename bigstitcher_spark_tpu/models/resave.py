@@ -17,6 +17,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from .. import observe
 from ..io.chunkstore import ChunkStore, StorageFormat
 from ..io.container import estimate_multires_pyramid, _relative_steps
 from ..io.dataset_io import ViewLoader, create_bdv_view_datasets
@@ -137,6 +138,16 @@ def resave(
         barrier(f"resave-s{lvl}")  # next level reads this level's chunks
 
     stats.seconds = time.time() - t0
+    observe.progress.record_stage(
+        "resave",
+        done=stats.s0_blocks + stats.pyramid_blocks,
+        views=stats.views,
+        s0_blocks=stats.s0_blocks,
+        pyramid_blocks=stats.pyramid_blocks,
+        seconds=round(stats.seconds, 3),
+        rate_per_s=round((stats.s0_blocks + stats.pyramid_blocks)
+                         / max(stats.seconds, 1e-9), 3),
+    )
     return stats
 
 

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from .. import observe
 from ..io.interestpoints import InterestPointStore
 from ..io.spimdata import SpimData, ViewId, ViewTransform, registration_hash
 from ..ops import models as M
@@ -161,8 +162,10 @@ def matches_from_stitching(
         links.setdefault((ka, kb), []).append(
             (corners, corners + S, float(res.correlation))
         )
-    if n_stale and verbose:
-        print(f"solver: skipped {n_stale} stale stitching links (registration hash changed)")
+    if n_stale:
+        observe.log(f"solver: skipped {n_stale} stale stitching links "
+                    "(registration hash changed)", stage="solver",
+                    echo=verbose, stale_links=n_stale)
     out = []
     for (ka, kb), items in sorted(links.items()):
         p = np.concatenate([i[0] for i in items])
@@ -228,8 +231,9 @@ def matches_from_interest_points(
                     (mine[c.id], theirs[c.other_id], weights.get(label, 1.0))
                 )
                 n_pts += 1
-    if verbose:
-        print(f"solver: {n_pts} corresponding interest points over {len(links)} pairs")
+    observe.log(f"solver: {n_pts} corresponding interest points over "
+                f"{len(links)} pairs", stage="solver", echo=verbose,
+                points=n_pts, pairs=len(links))
     out = []
     for (ka, kb), items in sorted(links.items()):
         p = np.stack([i[0] for i in items])
@@ -437,9 +441,10 @@ def solve_iterative(
         if not (worst > params.relative_threshold * avg
                 and worst > params.absolute_threshold):
             break
-        if verbose:
-            print(f"solver: dropping link {worst_key[0][0]}<->{worst_key[1][0]} "
-                  f"error {worst:.2f} (avg {avg:.2f})")
+        observe.log(f"solver: dropping link {worst_key[0][0]}<->"
+                    f"{worst_key[1][0]} error {worst:.2f} (avg {avg:.2f})",
+                    stage="solver", echo=verbose,
+                    error=round(float(worst), 3))
         links = [lk for lk in links if (lk.key_a, lk.key_b) != worst_key]
         removed.append(worst_key)
     res.removed_links.extend(removed)
@@ -505,11 +510,12 @@ def solve(
         links = matches_from_interest_points(
             sd, tiles, store, labels, params.label_weights, verbose
         )
-    if verbose:
-        print(f"solver: {len(tiles)} tiles, {len(links)} links, "
-              f"method {params.method}, model {params.model}"
-              + (f" reg {params.regularization} λ={params.lam}"
-                 if params.regularization != M.NONE else ""))
+    observe.log(f"solver: {len(tiles)} tiles, {len(links)} links, "
+                f"method {params.method}, model {params.model}"
+                + (f" reg {params.regularization} λ={params.lam}"
+                   if params.regularization != M.NONE else ""),
+                stage="solver", echo=verbose,
+                tiles=len(tiles), links=len(links))
 
     fixed = pick_fixed(tiles, params)
     iterative = params.method.endswith("ITERATIVE")
@@ -537,17 +543,22 @@ def solve(
 
     if two_round and len(comps) > 1:
         _align_components_to_metadata(comps, corrections, fixed, verbose)
-    elif not two_round and len(comps) > 1 and verbose:
-        print(f"solver: WARNING {len(comps)} unconnected subsets solved "
-              "independently (use TWO_ROUND_* to place them via metadata)")
+    elif not two_round and len(comps) > 1:
+        observe.log(f"solver: WARNING {len(comps)} unconnected subsets solved "
+                    "independently (use TWO_ROUND_* to place them via "
+                    "metadata)", stage="solver", echo=verbose,
+                    subsets=len(comps))
 
-    if verbose:
-        print(f"solver: done, max subset error {total_err:.3f} px "
-              f"({total_it} iterations total"
-              + (f", {len(removed)} links removed" if removed else "") + ")")
-        if total_err > params.max_error:
-            print(f"solver: WARNING did not reach --maxError "
-                  f"{params.max_error} px (best {total_err:.3f} px)")
+    observe.log(f"solver: done, max subset error {total_err:.3f} px "
+                f"({total_it} iterations total"
+                + (f", {len(removed)} links removed" if removed else "") + ")",
+                stage="solver", echo=verbose,
+                max_error_px=round(float(total_err), 4),
+                iterations=total_it, removed_links=len(removed))
+    if total_err > params.max_error:
+        observe.log(f"solver: WARNING did not reach --maxError "
+                    f"{params.max_error} px (best {total_err:.3f} px)",
+                    stage="solver", echo=verbose)
     return SolveResult(corrections, total_err, total_it, removed, link_errors)
 
 
@@ -564,9 +575,9 @@ def _align_components_to_metadata(comps, corrections, fixed, verbose):
         for k in comp:
             corrections[k] = corrections[k].copy()
             corrections[k][:, 3] -= mean_t
-        if verbose:
-            print(f"solver: re-anchored unconnected subset of {len(comp)} "
-                  f"tile(s) to metadata (Δ={np.round(mean_t, 2)})")
+        observe.log(f"solver: re-anchored unconnected subset of {len(comp)} "
+                    f"tile(s) to metadata (Δ={np.round(mean_t, 2)})",
+                    stage="solver", echo=verbose, tiles=len(comp))
 
 
 def _all_labels(sd: SpimData, views: list[ViewId]) -> list[str]:

@@ -38,7 +38,7 @@ from ..utils.geometry import (
     transformed_interval,
     translation_affine,
 )
-from .. import profiling
+from .. import observe, profiling
 
 
 @dataclass
@@ -307,8 +307,9 @@ def stitch_all_pairs(
     params = params or StitchingParams()
     groups = build_groups(sd, views)
     pairs = plan_pairs(sd, groups)
-    if progress:
-        print(f"stitching: {len(groups)} groups, {len(pairs)} overlapping pairs")
+    observe.log(f"stitching: {len(groups)} groups, {len(pairs)} overlapping "
+                "pairs", stage="stitching", echo=progress,
+                groups=len(groups), pairs=len(pairs))
 
     jobs: list[_PairJob] = []
     for ga, gb, ov in pairs:
@@ -472,9 +473,11 @@ def filter_results(
               and float(np.linalg.norm(shift)) <= params.max_shift_total)
         if ok:
             out.append(res)
-        elif verbose:
-            print(f"  dropped pair {res.views_a[0]}<->{res.views_b[0]}: "
-                  f"r={res.correlation:.3f} shift={np.round(shift, 2)}")
+        else:
+            observe.log(f"  dropped pair {res.views_a[0]}<->{res.views_b[0]}: "
+                        f"r={res.correlation:.3f} shift={np.round(shift, 2)}",
+                        stage="stitching", echo=verbose,
+                        correlation=round(float(res.correlation), 4))
     return out
 
 

@@ -1,0 +1,124 @@
+"""Structured run telemetry: event log, metrics registry, run manifests.
+
+The Spark reference gets observability for free from its runtime — an
+event log, a history server, per-stage task counts and retry accounting.
+This package is the TPU port's equivalent, threaded through every layer:
+
+- :mod:`.events` — append-only JSONL event log, one file per process
+  named by ``(process_index, process_count)`` so multi-host runs never
+  collide;
+- :mod:`.metrics` — always-on thread-safe counter/gauge/histogram
+  registry with a Prometheus-style textfile export;
+- :mod:`.progress` — per-stage heartbeats (done/total, rate, ETA) and
+  stage summary records;
+- :mod:`.manifest` — the per-run manifest written at command end plus
+  the ``bst telemetry-merge`` fold of N per-process files.
+
+Activation is one call — ``observe.configure(telemetry_dir)`` — wired to
+the shared ``--telemetry-dir`` / ``--profile`` CLI options; disabled (the
+default) every ``events.emit`` is a single ``is None`` check and nothing
+touches the filesystem.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from . import events, manifest, metrics, progress  # noqa: F401
+
+_STATE: dict = {
+    "dir": None,
+    "started_at": None,
+    "metrics_baseline": None,
+    "enabled_profiling": False,
+}
+
+
+def configure(telemetry_dir: str, profile: bool = True) -> None:
+    """Activate telemetry into ``telemetry_dir`` for the rest of this run.
+
+    Opens the per-process event log (lazily), snapshots the metrics
+    registry so the manifest reports this run's deltas, resets the stage
+    records, and (by default) enables the span profiler so the manifest
+    carries the span-stat table."""
+    from .. import profiling
+
+    d = os.path.abspath(telemetry_dir)
+    os.makedirs(d, exist_ok=True)
+    events.configure(d)
+    progress.reset_records()
+    _STATE["dir"] = d
+    _STATE["started_at"] = time.time()
+    _STATE["metrics_baseline"] = metrics.get_registry().snapshot()
+    if profile and not profiling.get().enabled:
+        profiling.enable(True)
+        _STATE["enabled_profiling"] = True
+    events.emit("run.start", argv=list(sys.argv), pid=os.getpid())
+
+
+def active() -> bool:
+    return _STATE["dir"] is not None
+
+
+def telemetry_dir() -> str | None:
+    return _STATE["dir"]
+
+
+def log(message: str, stage: str | None = None, echo: bool = True,
+        **fields) -> None:
+    """Structured replacement for the drivers' bare ``print``: always an
+    event (when telemetry is on), a stdout line only when ``echo`` —
+    callers pass their existing ``progress``/``verbose`` flag, so console
+    behavior is unchanged while the event log sees everything."""
+    if events.enabled():
+        events.emit("log", stage=stage, message=message, **fields)
+    if echo:
+        print(message)
+
+
+def finalize(tool: str | None = None, params: dict | None = None,
+             status: str = "ok", error: str | None = None) -> str | None:
+    """End the telemetry run: write the Prometheus textfile and the run
+    manifest, close the event log, restore profiler state. Idempotent —
+    returns the manifest path, or None when telemetry was never
+    configured."""
+    from .. import profiling
+
+    if not active():
+        return None
+    d = _STATE["dir"]
+    pi, pc = events.world()
+    reg = metrics.get_registry()
+    prom_path = os.path.join(d, f"metrics-{pi:05d}-of-{pc:05d}.prom")
+    with open(prom_path, "w", encoding="utf-8") as f:
+        f.write(reg.render_prometheus())
+    spans = {k: {"count": s.count, "total_s": round(s.total_s, 3),
+                 "max_s": round(s.max_s, 3)}
+             for k, s in profiling.get().stats().items()}
+    seconds = time.time() - _STATE["started_at"]
+    events.emit("run.end", status=status, seconds=round(seconds, 3),
+                error=error)
+    ev_path = events.close()
+    path = manifest.write_manifest(
+        d,
+        tool=tool,
+        argv=list(sys.argv),
+        params=params,
+        world=(pi, pc),
+        started_at=_STATE["started_at"],
+        seconds=seconds,
+        status=status,
+        error=error,
+        spans=spans,
+        metrics_delta=reg.snapshot_delta(_STATE["metrics_baseline"]),
+        stages=progress.records(),
+        events_file=os.path.basename(ev_path) if ev_path else None,
+    )
+    progress.reset_records()
+    if _STATE["enabled_profiling"]:
+        profiling.enable(False)
+    _STATE.update(dir=None, started_at=None, metrics_baseline=None,
+                  enabled_profiling=False)
+    return path

@@ -1,0 +1,117 @@
+"""Append-only JSONL event log, one file per process.
+
+The Spark reference's event log (spark.eventLog / the history server)
+re-expressed for the multi-host SPMD runtime: every process appends to its
+own ``events-{process_index:05d}-of-{process_count:05d}.jsonl`` inside the
+run's telemetry directory, so pod runs never collide on a shared
+filesystem and ``bst telemetry-merge`` can fold the N files afterwards.
+
+Disabled (the default) the hot-path cost is one ``is None`` check per
+``emit`` call; enabled, each event is one buffered+flushed JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_lock = threading.RLock()
+_dir: str | None = None
+_file = None
+_path: str | None = None
+
+
+def world() -> tuple[int, int]:
+    """(process_index, process_count), preferring the live jax runtime and
+    falling back to the BST_* launch env (so filenames are stable even
+    before/without backend init)."""
+    try:
+        from ..parallel.distributed import world as _w
+
+        return _w()
+    except Exception:
+        return (int(os.environ.get("BST_PROCESS_ID", "0") or 0),
+                int(os.environ.get("BST_NUM_PROCESSES", "1") or 1))
+
+
+def event_log_name(process_index: int, process_count: int) -> str:
+    return f"events-{process_index:05d}-of-{process_count:05d}.jsonl"
+
+
+def configure(directory: str) -> None:
+    """Route subsequent ``emit`` calls to ``directory`` (file opened lazily
+    on first event, in append mode — reruns extend, never truncate)."""
+    global _dir, _file, _path
+    with _lock:
+        if _file is not None:
+            _file.close()
+        _dir, _file, _path = os.path.abspath(directory), None, None
+        os.makedirs(_dir, exist_ok=True)
+
+
+def enabled() -> bool:
+    return _dir is not None
+
+
+def path() -> str | None:
+    return _path
+
+
+def _json_safe(o):
+    if hasattr(o, "dtype") and getattr(o, "ndim", 1) == 0:
+        if o.dtype.kind in "ui":
+            return int(o)
+        if o.dtype.kind == "f":
+            return float(o)
+        if o.dtype.kind == "b":
+            return bool(o)
+    if hasattr(o, "tolist"):
+        return o.tolist()
+    return str(o)
+
+
+def emit(etype: str, **fields) -> None:
+    """Append one event; no-op unless configured. ``None`` fields drop."""
+    if _dir is None:
+        return
+    with _lock:
+        if _dir is None:
+            return
+        global _file, _path
+        if _file is None:
+            pi, pc = world()
+            _path = os.path.join(_dir, event_log_name(pi, pc))
+            _file = open(_path, "a", encoding="utf-8")
+        rec = {"ts": round(time.time(), 6), "type": etype}
+        rec.update({k: v for k, v in fields.items() if v is not None})
+        _file.write(json.dumps(rec, default=_json_safe) + "\n")
+        _file.flush()
+
+
+def close() -> str | None:
+    """Close the log and de-configure; returns the written path (if any)."""
+    global _dir, _file, _path
+    with _lock:
+        p = _path
+        if _file is not None:
+            _file.close()
+        _dir, _file, _path = None, None, None
+        return p
+
+
+def iter_events(path: str):
+    """Parse a JSONL event file back into dicts (round-trip reader used by
+    tests and the merge tool). Unparseable lines are skipped, not fatal:
+    a crash can tear a line mid-write, and append-mode reruns then bury
+    the torn line mid-file — later events must still be readable."""
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue

@@ -1,0 +1,118 @@
+"""Per-stage progress heartbeats and stage summary records.
+
+``Heartbeat`` is the per-block progress channel of the block-writing
+drivers (affine fusion, resave, downsample, nonrigid): rate-limited
+``stage.progress`` events with done/total, blocks/s and ETA, plus a final
+``stage.end`` record that captures ETA-vs-actual for the run manifest.
+``record_stage`` lets a driver file its own end-of-stage summary (block /
+voxel totals from its stats object).
+
+Stage records accumulate only while telemetry is configured, so library
+use (bench loops, tests) never grows unbounded state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from . import events, metrics
+
+_rec_lock = threading.Lock()
+_records: list[dict] = []
+
+
+def reset_records() -> None:
+    with _rec_lock:
+        _records.clear()
+
+
+def records() -> list[dict]:
+    with _rec_lock:
+        return [dict(r) for r in _records]
+
+
+def _append_record(rec: dict) -> None:
+    if not events.enabled():
+        return
+    with _rec_lock:
+        _records.append(rec)
+
+
+def record_stage(stage: str, **fields) -> None:
+    """File a driver's end-of-stage summary (manifest ``stages`` table)."""
+    rec = {"stage": stage, **{k: v for k, v in fields.items()
+                              if v is not None}}
+    events.emit("stage.summary", **rec)
+    _append_record(rec)
+
+
+class Heartbeat:
+    """Thread-safe done/total progress for one work list.
+
+    ``tick`` per completed item; emits ``stage.progress`` at most every
+    ``every_s`` seconds (always on completion). The first emitted ETA is
+    kept so the manifest can show estimate-vs-actual.
+    """
+
+    def __init__(self, stage: str, total: int, every_s: float = 2.0):
+        self.stage = stage
+        self.total = int(total)
+        self.every_s = every_s
+        self._lock = threading.Lock()
+        self._done = 0
+        self._retry_rounds = 0
+        self._t0 = time.perf_counter()
+        self._last_emit = self._t0
+        self._eta_first_s: float | None = None
+        self._counter = metrics.counter("bst_stage_items_done_total",
+                                        stage=stage)
+        self._finished = False
+        events.emit("stage.start", stage=stage, total=self.total)
+
+    def tick(self, n: int = 1) -> None:
+        self._counter.inc(n)
+        with self._lock:
+            self._done += n
+            if not events.enabled():
+                return
+            now = time.perf_counter()
+            done, total = self._done, self.total
+            if now - self._last_emit < self.every_s and done < total:
+                return
+            self._last_emit = now
+            elapsed = now - self._t0
+            rate = done / max(elapsed, 1e-9)
+            eta_s = (total - done) / max(rate, 1e-9)
+            if self._eta_first_s is None:
+                # projected total duration at the first estimate
+                self._eta_first_s = elapsed + eta_s
+        events.emit("stage.progress", stage=self.stage, done=done,
+                    total=total, rate_per_s=round(rate, 3),
+                    eta_s=round(eta_s, 1))
+
+    def retry_round(self) -> None:
+        with self._lock:
+            self._retry_rounds += 1
+
+    def finish(self, **extra) -> dict:
+        with self._lock:
+            if self._finished:
+                return {}
+            self._finished = True
+            elapsed = time.perf_counter() - self._t0
+            rec = {
+                "stage": self.stage,
+                "done": self._done,
+                "total": self.total,
+                "seconds": round(elapsed, 3),
+                "rate_per_s": round(self._done / max(elapsed, 1e-9), 3),
+                "retry_rounds": self._retry_rounds,
+            }
+            if self._eta_first_s is not None:
+                rec["eta_first_s"] = round(self._eta_first_s, 3)
+                rec["eta_error_s"] = round(elapsed - self._eta_first_s, 3)
+        rec.update({k: v for k, v in extra.items() if v is not None})
+        events.emit("stage.end", **rec)
+        _append_record(rec)
+        return rec

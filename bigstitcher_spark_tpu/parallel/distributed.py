@@ -74,12 +74,24 @@ def barrier(name: str = "bst") -> None:
     copy -> pyramid level 1, level k -> level k+1): a later stage may read
     chunks another process wrote, so all processes must pass the boundary
     together. No-op at world size 1 (the reference gets the same ordering
-    from Spark's stage-by-stage collect)."""
+    from Spark's stage-by-stage collect).
+
+    Wait time is recorded per barrier name — it is the straggler signal of
+    a pod run (a process stuck in IO shows up as everyone else's barrier
+    seconds)."""
     if world()[1] <= 1:
         return
+    import time
+
     from jax.experimental import multihost_utils
 
+    from ..observe import events, metrics
+
+    t0 = time.perf_counter()
     multihost_utils.sync_global_devices(name)
+    dt = time.perf_counter() - t0
+    metrics.histogram("bst_barrier_seconds", name=name).observe(dt)
+    events.emit("barrier", name=name, seconds=round(dt, 4))
 
 
 def world() -> tuple[int, int]:

@@ -29,8 +29,16 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import fusion as F
+from ..observe import metrics as _metrics
+from .. import observe
 
 BLOCK_AXIS = "blocks"
+
+# host<->device transfer accounting (the tunnel/PCIe wire is the scarce
+# resource on remote accelerators — PERF.md §3h): stacked batch inputs are
+# the h2d side, fetched outputs the d2h side
+_H2D_BYTES = _metrics.counter("bst_xfer_h2d_bytes_total")
+_D2H_BYTES = _metrics.counter("bst_xfer_d2h_bytes_total")
 
 
 @functools.lru_cache(maxsize=8)
@@ -202,6 +210,7 @@ def run_sharded_batches(
              for j in range(len(inputs[0]))],
             -(-len(inputs) // max(n_dev, 1)) * max(n_dev, 1),
         )
+        _H2D_BYTES.inc(sum(a.nbytes for a in stacked))
         outs = kernel(*stacked)
         return outs if isinstance(outs, (tuple, list)) else (outs,)
 
@@ -245,6 +254,7 @@ def run_sharded_batches(
                     prefetched[nxt2] = [pool.submit(build, it)
                                         for it in batches[nxt2]]
         outs = jax.device_get(list(outs))  # pipelined multi-output fetch
+        _D2H_BYTES.inc(sum(int(getattr(o, "nbytes", 0)) for o in outs))
         wfuts = [
             pool.submit(consume, it, *(o[i] for o in outs))
             for i, it in enumerate(batch)
@@ -253,7 +263,8 @@ def run_sharded_batches(
             w.result()
         completed.add(bi)
         if progress:
-            print(f"  {label}: batch {bi + 1}/{len(batches)} done")
+            observe.log(f"  {label}: batch {bi + 1}/{len(batches)} done",
+                        stage=label)
 
     run_with_retry(list(enumerate(batches)), process_batch, label=label)
 

@@ -4,6 +4,11 @@ The reference resubmits failed grid blocks ≤5 times with a 2 s delay, then
 gives up hard (RetryTrackerSpark.java:28-61; loops at
 SparkAffineFusion.java:467-479,682-696). Block writes are idempotent, so
 resubmission is always safe.
+
+Every run feeds the observability layer: a per-stage progress heartbeat
+(done/total, rate, ETA), ``block.fail`` / ``retry.round`` events carrying
+the exception class, and retry/failure counters — the Spark retry
+accounting this port previously only ``print``ed.
 """
 
 from __future__ import annotations
@@ -12,6 +17,8 @@ import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence, TypeVar
+
+from ..observe import events, metrics, progress
 
 T = TypeVar("T")
 
@@ -35,15 +42,19 @@ def run_with_retry(
     chunk copy work (tensorstore releases the GIL; writers own disjoint
     chunks by construction). Returns the number of retry rounds used. Raises
     RetryError when items still fail after ``max_retries`` rounds (reference
-    exits the JVM)."""
+    exits the JVM); its message includes the per-exception-class failure
+    breakdown accumulated across ALL rounds, not just the first traceback."""
     pending: list[T] = list(items)
     rounds = 0
+    err_counts: dict[str, int] = {}
+    hb = progress.Heartbeat(label, len(pending))
     while pending:
         failed: list[tuple[T, Exception]] = []
 
         def attempt(it: T):
             try:
                 process(it)
+                hb.tick()
                 return None
             except Exception as e:  # noqa: BLE001 - any task failure is retryable
                 return (it, e)
@@ -53,15 +64,38 @@ def run_with_retry(
                 failed = [r for r in pool.map(attempt, pending) if r is not None]
         else:
             failed = [r for r in map(attempt, pending) if r is not None]
+        for _, e in failed:
+            exc = type(e).__name__
+            err_counts[exc] = err_counts.get(exc, 0) + 1
+            metrics.counter("bst_blocks_failed_total", stage=label,
+                            exception=exc).inc()
+        if events.enabled():
+            for it, e in failed:
+                events.emit("block.fail", stage=label,
+                            exception=type(e).__name__,
+                            error=repr(e)[:300], round=rounds)
         if not failed:
-            return rounds
+            break
         rounds += 1
+        hb.retry_round()
+        metrics.counter("bst_retry_rounds_total", stage=label).inc()
         if rounds > max_retries:
+            hb.finish(failed=len(failed))
+            events.emit("retry.exhausted", stage=label,
+                        failures=len(failed), rounds=rounds - 1,
+                        by_exception=err_counts)
             tb = "".join(traceback.format_exception(failed[0][1]))
+            breakdown = ", ".join(
+                f"{k} x{v}" for k, v in sorted(err_counts.items(),
+                                               key=lambda kv: -kv[1]))
             raise RetryError(
                 f"{len(failed)} {label}(s) still failing after "
-                f"{max_retries} retries; first error:\n{tb}"
+                f"{max_retries} retries; failure breakdown across rounds: "
+                f"{breakdown}; first error:\n{tb}"
             )
+        events.emit("retry.round", stage=label, round=rounds,
+                    max_retries=max_retries, failures=len(failed),
+                    by_exception=err_counts, delay_s=delay_s)
         if verbose:
             print(
                 f"[retry] {len(failed)} {label}(s) failed "
@@ -70,4 +104,5 @@ def run_with_retry(
             )
         time.sleep(delay_s)
         pending = [it for it, _ in failed]
+    hb.finish()
     return rounds

@@ -45,9 +45,12 @@ class Profiler:
                     for k, v in self._stats.items()}
 
     def report(self) -> str:
+        # stats() snapshots under the lock — iterating self._stats directly
+        # here raced with concurrent record() calls mutating the dict
+        stats = self.stats()
         lines = ["span                            count    total_s      max_s"]
-        for k in sorted(self._stats):
-            s = self._stats[k]
+        for k in sorted(stats):
+            s = stats[k]
             lines.append(f"{k:<30} {s.count:>6} {s.total_s:>10.3f} {s.max_s:>10.3f}")
         return "\n".join(lines)
 

@@ -8,10 +8,27 @@ cd /root/repo
 ATTEMPTS=${1:-150}
 SLEEP=${2:-240}
 TAG=${3:-r05}
+# per-run telemetry (event log, metrics textfile, run manifest) rides along
+# with every bench attempt; on capture the manifests are archived beside
+# the BENCH json/log so the span/IO story of the recorded run is kept
+TELEMETRY_DIR=${BST_TELEMETRY_DIR:-/tmp/bst_bench_telemetry_${TAG}}
+archive_telemetry () {
+  local dest="BENCH_TPU_${TAG}_telemetry"
+  if ls "$TELEMETRY_DIR"/manifest-*.json >/dev/null 2>&1; then
+    mkdir -p "$dest"
+    cp "$TELEMETRY_DIR"/manifest-*.json "$TELEMETRY_DIR"/metrics-*.prom \
+       "$TELEMETRY_DIR"/events-*.jsonl "$dest"/ 2>/dev/null
+    echo "[loop $(date +%T)] telemetry archived to $dest"
+  fi
+}
 for i in $(seq 1 "$ATTEMPTS"); do
   if timeout 150 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d; print('live', d[0].platform)" >/tmp/tpu_probe.log 2>&1; then
     echo "[loop $(date +%T)] tunnel live ($(tail -1 /tmp/tpu_probe.log)), running bench"
-    timeout 5500 env BST_BENCH_TPU_ONLY=1 BST_BENCH_CHILD_TIMEOUT=2500 python bench.py >/tmp/bench_tpu_out.json 2>/tmp/bench_tpu_err.log
+    # clear only the telemetry file patterns (never rm -rf an operator-
+    # supplied BST_TELEMETRY_DIR that may hold unrelated prior runs)
+    rm -f "$TELEMETRY_DIR"/manifest-*.json "$TELEMETRY_DIR"/metrics-*.prom \
+          "$TELEMETRY_DIR"/events-*.jsonl 2>/dev/null
+    timeout 5500 env BST_BENCH_TPU_ONLY=1 BST_BENCH_CHILD_TIMEOUT=2500 BST_TELEMETRY_DIR="$TELEMETRY_DIR" python bench.py >/tmp/bench_tpu_out.json 2>/tmp/bench_tpu_err.log
     rc=$?
     # capture only a real, non-fallback artifact: rc 0 plus one JSON line
     # holding the primary metric on a non-cpu platform (an empty stdout
@@ -24,10 +41,12 @@ for i in $(seq 1 "$ATTEMPTS"); do
         # has a validated primary) but keep hunting for a complete one
         cp /tmp/bench_tpu_out.json "BENCH_TPU_${TAG}.json"
         cp /tmp/bench_tpu_err.log "BENCH_TPU_${TAG}.log"
+        archive_telemetry
         echo "[loop $(date +%T)] truncated TPU artifact saved; retrying for a complete one"
       else
         cp /tmp/bench_tpu_out.json "BENCH_TPU_${TAG}.json"
         cp /tmp/bench_tpu_err.log "BENCH_TPU_${TAG}.log"
+        archive_telemetry
         echo "[loop $(date +%T)] TPU BENCH CAPTURED:"
         cat "BENCH_TPU_${TAG}.json"
         exit 0

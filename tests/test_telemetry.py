@@ -1,0 +1,377 @@
+"""Observability layer: metrics registry, JSONL event log, run manifests,
+and the end-to-end ``--telemetry-dir`` CLI path.
+
+Marker-free on purpose — tier-1 covers the telemetry path on CPU (the
+acceptance contract of the observability PR): a tiny affine-fusion run
+with ``--telemetry-dir`` must leave an event log, a Prometheus textfile
+and a manifest whose block counts and byte totals match the output
+container.
+"""
+
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from bigstitcher_spark_tpu import observe
+from bigstitcher_spark_tpu.observe import events, manifest, metrics, progress
+
+
+@pytest.fixture(autouse=True)
+def _clean_observe_state():
+    """Telemetry state is process-global; never leak it between tests."""
+    yield
+    if observe.active():
+        observe.finalize(tool="test-cleanup")
+    events.close()
+
+
+class TestMetricsRegistry:
+    def test_counter_thread_safety(self):
+        reg = metrics.MetricsRegistry()
+        c = reg.counter("t_ops_total", stage="x")
+        n_threads, per_thread = 8, 5000
+
+        def work():
+            for _ in range(per_thread):
+                c.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == n_threads * per_thread
+
+    def test_labels_make_distinct_series(self):
+        reg = metrics.MetricsRegistry()
+        a = reg.counter("io_bytes_total", path="native")
+        b = reg.counter("io_bytes_total", path="tensorstore")
+        assert a is not b
+        a.inc(10)
+        b.inc(1)
+        snap = reg.snapshot()
+        assert snap['io_bytes_total{path="native"}'] == 10
+        assert snap['io_bytes_total{path="tensorstore"}'] == 1
+        # same (name, labels) -> same handle
+        assert reg.counter("io_bytes_total", path="native") is a
+
+    def test_type_conflict_rejected(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("thing")
+        with pytest.raises(ValueError):
+            reg.gauge("thing")
+
+    def test_reset_keeps_handles_valid(self):
+        reg = metrics.MetricsRegistry()
+        c = reg.counter("n_total")
+        c.inc(5)
+        reg.reset()
+        assert c.value == 0
+        c.inc(2)
+        assert reg.snapshot()["n_total"] == 2
+
+    def test_snapshot_delta(self):
+        reg = metrics.MetricsRegistry()
+        c = reg.counter("bytes_total")
+        g = reg.gauge("level")
+        c.inc(100)
+        g.set(3)
+        base = reg.snapshot()
+        c.inc(42)
+        g.set(7)
+        delta = reg.snapshot_delta(base)
+        assert delta["bytes_total"] == 42
+        assert delta["level"] == 7  # gauges report current value
+
+    def test_prometheus_textfile_format(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("bst_io_read_bytes_total", path="native").inc(4096)
+        reg.gauge("bst_inflight").set(2)
+        h = reg.histogram("bst_barrier_seconds", buckets=(0.1, 1.0),
+                          name="s0")
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(30.0)
+        text = reg.render_prometheus()
+        assert "# TYPE bst_io_read_bytes_total counter" in text
+        assert '\nbst_io_read_bytes_total{path="native"} 4096' in text
+        assert "# TYPE bst_inflight gauge" in text
+        assert "# TYPE bst_barrier_seconds histogram" in text
+        # cumulative buckets + +Inf + _sum/_count, labels preserved
+        assert re.search(
+            r'bst_barrier_seconds_bucket\{le="0\.1",name="s0"\} 1', text)
+        assert re.search(
+            r'bst_barrier_seconds_bucket\{le="\+Inf",name="s0"\} 3', text)
+        assert re.search(r'bst_barrier_seconds_count\{name="s0"\} 3', text)
+        # every sample line is `name{labels} value` or `# ...`
+        for line in text.strip().splitlines():
+            assert line.startswith("#") or re.fullmatch(
+                r'[a-zA-Z_:][\w:]*(\{[^}]*\})? -?[\d.e+-]+', line), line
+
+
+class TestEventLog:
+    def test_round_trip(self, tmp_path):
+        d = str(tmp_path / "tel")
+        events.configure(d)
+        try:
+            events.emit("stage.start", stage="fusion", total=12)
+            events.emit("block.ok", stage="fusion",
+                        bytes=np.int64(4096), offset=np.array([0, 0, 0]))
+            events.emit("drops.none.fields", empty=None)
+        finally:
+            path = events.close()
+        assert path is not None
+        assert os.path.basename(path) == "events-00000-of-00001.jsonl"
+        recs = list(events.iter_events(path))
+        assert [r["type"] for r in recs] == [
+            "stage.start", "block.ok", "drops.none.fields"]
+        assert all("ts" in r for r in recs)
+        assert recs[0]["total"] == 12
+        assert recs[1]["bytes"] == 4096  # numpy scalars serialize as numbers
+        assert recs[1]["offset"] == [0, 0, 0]
+        assert "empty" not in recs[2]
+
+    def test_disabled_is_noop(self, tmp_path):
+        assert not events.enabled()
+        events.emit("never", x=1)  # must not raise or create files
+        assert events.path() is None
+
+    def test_append_not_truncate(self, tmp_path):
+        d = str(tmp_path / "tel")
+        events.configure(d)
+        events.emit("a")
+        p = events.close()
+        events.configure(d)
+        events.emit("b")
+        assert events.close() == p
+        assert [r["type"] for r in events.iter_events(p)] == ["a", "b"]
+
+
+class TestRetryTelemetry:
+    def test_exception_breakdown_in_retry_error(self):
+        from bigstitcher_spark_tpu.parallel.retry import (
+            RetryError, run_with_retry,
+        )
+
+        def boom(it):
+            if it % 2:
+                raise ValueError(f"odd {it}")
+            raise TypeError(f"even {it}")
+
+        with pytest.raises(RetryError) as ei:
+            run_with_retry([1, 2, 3], boom, max_retries=2, delay_s=0.0,
+                           label="t-block", verbose=False)
+        msg = str(ei.value)
+        assert "failure breakdown across rounds" in msg
+        # 2 odd + 1 even items x 3 rounds (initial + 2 retries)
+        assert "ValueError x6" in msg
+        assert "TypeError x3" in msg
+        assert "first error:" in msg
+
+    def test_retry_events_and_recovery(self, tmp_path):
+        from bigstitcher_spark_tpu.parallel.retry import run_with_retry
+
+        observe.configure(str(tmp_path / "tel"), profile=False)
+        flaky = {"left": 2}
+
+        def sometimes(it):
+            if it == 3 and flaky["left"] > 0:
+                flaky["left"] -= 1
+                raise OSError("transient")
+
+        rounds = run_with_retry([1, 2, 3], sometimes, max_retries=5,
+                                delay_s=0.0, label="t-retry", verbose=False)
+        assert rounds == 2
+        observe.finalize(tool="t")
+        path = os.path.join(str(tmp_path / "tel"),
+                            "events-00000-of-00001.jsonl")
+        types = [r["type"] for r in events.iter_events(path)]
+        assert types.count("block.fail") == 2
+        assert types.count("retry.round") == 2
+        assert "stage.start" in types and "stage.end" in types
+        end = [r for r in events.iter_events(path)
+               if r["type"] == "stage.end"][0]
+        assert end["done"] == 3 and end["total"] == 3
+        assert end["retry_rounds"] == 2
+
+
+class TestProfilerReport:
+    def test_report_uses_snapshot(self):
+        from bigstitcher_spark_tpu import profiling
+
+        p = profiling.Profiler()
+        p.record("stage.a", 0.5)
+        p.record("stage.a", 1.5)
+        rep = p.report()
+        assert "stage.a" in rep
+        assert re.search(r"stage\.a\s+2\s+2\.000\s+1\.500", rep)
+
+
+class TestManifestMerge:
+    def _fake_process(self, d, pi, pc, write_bytes, fail_events=0):
+        events.configure(d)
+        # monkey-free: emit through the real writer under a forced world
+        events.emit("stage.start", stage="fusion", total=8)
+        for _ in range(fail_events):
+            events.emit("block.fail", stage="fusion",
+                        exception="TimeoutError", error="t/o", round=0)
+        events.close()
+        return manifest.write_manifest(
+            d, tool="affine-fusion", argv=["bst"], params={"o": "x"},
+            world=(pi, pc), started_at=0.0, seconds=10.0 + pi,
+            status="ok", error=None,
+            spans={"fusion.kernel": {"count": 4, "total_s": 2.0,
+                                     "max_s": 1.0}},
+            metrics_delta={'bst_io_write_bytes_total{path="native"}':
+                           write_bytes},
+            stages=[{"stage": "affine-fusion", "done": 4, "total": 4,
+                     "seconds": 10.0 + pi, "voxels": 1000}],
+            events_file=None,
+        )
+
+    def test_merge_across_processes(self, tmp_path):
+        d = str(tmp_path / "tel")
+        os.makedirs(d)
+        # two per-process manifest files must not collide
+        p0 = self._fake_process(d, 0, 2, write_bytes=1000, fail_events=1)
+        p1 = self._fake_process(d, 1, 2, write_bytes=500, fail_events=2)
+        assert os.path.basename(p0) != os.path.basename(p1)
+
+        report = manifest.merge_run(d)
+        assert len(report["processes"]) == 2
+        assert report["process_count"] == 2
+        assert report["wall_clock_s"] == 11.0  # slowest process
+        m = report["metrics"]
+        assert m['bst_io_write_bytes_total{path="native"}'] == 1500
+        s = {r["stage"]: r for r in report["stages"]}
+        assert s["affine-fusion"]["done"] == 8  # summed across processes
+        assert s["affine-fusion"]["voxels"] == 2000
+        assert report["spans"]["fusion.kernel"]["count"] == 8
+        assert report["spans"]["fusion.kernel"]["max_s"] == 1.0
+        assert report["failures_by_exception"] == {"TimeoutError": 3}
+
+    def test_merge_empty_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            manifest.merge_run(str(tmp_path))
+
+
+class TestCliTelemetryEndToEnd:
+    def test_affine_fusion_telemetry_dir(self, tmp_path):
+        from bigstitcher_spark_tpu.cli.main import cli
+        from bigstitcher_spark_tpu.io.chunkstore import ChunkStore
+        from bigstitcher_spark_tpu.io.container import read_container_meta
+        from bigstitcher_spark_tpu.utils.testdata import (
+            make_synthetic_project,
+        )
+
+        proj = make_synthetic_project(
+            str(tmp_path / "p"), n_tiles=(2, 1, 1), tile_size=(32, 32, 16),
+            overlap=8, jitter=0.0, seed=11, n_beads_per_tile=6)
+        out = str(tmp_path / "fused.ome.zarr")
+        tel = str(tmp_path / "telemetry")
+        runner = CliRunner()
+        r = runner.invoke(cli, [
+            "create-fusion-container", "-x", proj.xml_path, "-o", out,
+            "-s", "ZARR", "-d", "UINT16", "--blockSize", "16,16,8",
+            "--minIntensity", "0", "--maxIntensity", "65535",
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        r = runner.invoke(cli, [
+            "affine-fusion", "-o", out, "--blockScale", "1,1,1",
+            "--telemetry-dir", tel,
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        assert not observe.active()  # finalized when the command closed
+
+        # --- manifest ---
+        mpath = os.path.join(tel, "manifest-00000-of-00001.json")
+        with open(mpath) as f:
+            man = json.load(f)
+        assert man["schema"] == manifest.SCHEMA
+        assert man["tool"] == "affine-fusion"
+        assert man["status"] == "ok"
+        assert man["params"]["output"] == out
+        assert man["world"] == {"process_index": 0, "process_count": 1}
+        assert man["device"]["platform"] == "cpu"
+
+        # --- block counts match the output container's grid ---
+        store = ChunkStore.open(out)
+        meta = read_container_meta(store)
+        shape = meta.bbox.shape
+        bs = meta.block_size
+        expected_blocks = int(np.prod(
+            [-(-int(s) // int(b)) for s, b in zip(shape, bs)]))
+        fusion_stages = [s for s in man["stages"]
+                         if s["stage"] == "affine-fusion"]
+        assert len(fusion_stages) == 1
+        st = fusion_stages[0]
+        assert st["blocks"] == expected_blocks
+        voxels = int(np.prod(shape))
+        assert st["voxels"] == voxels
+        assert st["seconds"] > 0 and st["voxels_per_s"] > 0
+
+        # --- byte totals match the container ---
+        ds = store.open_dataset("0")
+        container_bytes = int(np.prod(ds.shape)) * ds.dtype.itemsize
+        assert container_bytes == voxels * 2  # uint16, c=t=1
+        written = sum(v for k, v in man["metrics"].items()
+                      if k.startswith("bst_io_write_bytes_total"))
+        assert written == container_bytes
+        read = sum(v for k, v in man["metrics"].items()
+                   if k.startswith("bst_io_read_bytes_total"))
+        assert read > 0  # source patches were read through the IO layer
+
+        # --- span table rode along (configure enables the profiler) ---
+        assert any(k.startswith("fusion.") for k in man["spans"])
+
+        # --- event log round-trips ---
+        epath = os.path.join(tel, man["events_file"])
+        recs = list(events.iter_events(epath))
+        types = {r["type"] for r in recs}
+        assert {"run.start", "run.end", "stage.start", "stage.end",
+                "stage.summary", "io.write"} <= types
+        io_w = sum(r["bytes"] for r in recs if r["type"] == "io.write")
+        assert io_w == container_bytes
+
+        # --- metrics textfile ---
+        prom = open(os.path.join(tel, "metrics-00000-of-00001.prom")).read()
+        assert "# TYPE bst_io_write_bytes_total counter" in prom
+        assert "bst_stage_items_done_total" in prom
+
+        # --- merge tool folds the single-process run ---
+        r = runner.invoke(cli, ["telemetry-merge", tel],
+                          catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        with open(os.path.join(tel, "merged-report.json")) as f:
+            merged = json.load(f)
+        assert merged["schema"] == manifest.MERGED_SCHEMA
+        assert merged["processes"][0]["tool"] == "affine-fusion"
+        assert merged["metrics"] == man["metrics"]
+        assert "affine-fusion" in [s["stage"] for s in merged["stages"]]
+
+    def test_telemetry_default_off(self, tmp_path):
+        """Without --telemetry-dir nothing is configured and no telemetry
+        files appear (the zero-overhead default)."""
+        from bigstitcher_spark_tpu.cli.main import cli
+        from bigstitcher_spark_tpu.utils.testdata import (
+            make_synthetic_project,
+        )
+
+        proj = make_synthetic_project(
+            str(tmp_path / "p"), n_tiles=(1, 1, 1), tile_size=(24, 24, 12),
+            overlap=4, n_beads_per_tile=3)
+        out = str(tmp_path / "c.n5")
+        r = CliRunner().invoke(cli, [
+            "create-fusion-container", "-x", proj.xml_path, "-o", out,
+            "-s", "N5", "-d", "UINT16", "--blockSize", "16,16,8",
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        assert not observe.active()
+        assert not events.enabled()
+        assert not any(f.startswith(("events-", "manifest-", "metrics-"))
+                       for f in os.listdir(str(tmp_path)))
