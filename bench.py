@@ -178,13 +178,44 @@ def _kernel_rate(dispatch_fn, reps=10, tries=3):
     `dispatch_fn()` must dispatch exactly one execution of the program
     under test and return its output. Identical on CPU/TPU backends;
     under axon it is the only methodology whose numbers respect the
-    hardware's bandwidth bounds (see `_tiny_fetch`)."""
+    hardware's bandwidth bounds (see `_tiny_fetch`).
+
+    Syncing only the LAST dispatch relies on the single PJRT stream
+    executing the k dispatches in order — valid on one device only. With
+    multiple visible devices (multi-chip hosts) one element of EVERY
+    addressable shard of EVERY rep's first leaf is fetched in one
+    pipelined device_get after the dispatch loop, so reps that landed on
+    other streams/devices — including sharded outputs — cannot still be
+    in flight when the clock stops (ADVICE r5). Only the first array
+    leaf per rep is retained (a leaf's availability implies its whole
+    program ran; holding full output tuples for k reps would multiply
+    device residency by the rep count), and the single batched fetch
+    keeps the round-trip constant comparable to the k=1 run."""
+    import jax
+
+    single_stream = len(jax.devices()) == 1
+
+    def _first_leaf(out):
+        return next(x for x in jax.tree_util.tree_leaves(out)
+                    if hasattr(x, "dtype") and getattr(x, "size", 0))
+
     def run(k):
         t0 = time.time()
-        out = None
+        leaves = []
         for _ in range(k):
             out = dispatch_fn()
-        _tiny_fetch(out)
+            if not single_stream:
+                leaves.append(_first_leaf(out))
+        if single_stream:
+            _tiny_fetch(out)
+        else:
+            probes = []
+            for leaf in leaves:
+                shards = getattr(leaf, "addressable_shards", None) or []
+                datas = [s.data for s in shards] or [leaf]
+                probes.extend(d.reshape(-1)[:1] for d in datas
+                              if getattr(d, "size", 0))
+            jax.device_get(probes)  # one pipelined multi-shard sync
         return time.time() - t0
 
     run(1)  # warm any residual compile/transfer
@@ -421,7 +452,8 @@ def _io_snapshot(baseline):
 
     delta = metrics.get_registry().snapshot_delta(baseline)
     return {k: int(v) for k, v in delta.items()
-            if k.startswith(("bst_io_", "bst_xfer_"))
+            if k.startswith(("bst_io_", "bst_xfer_", "bst_chunk_cache_",
+                             "bst_tile_cache_", "bst_inflight_"))
             and isinstance(v, (int, float)) and v}
 
 

@@ -1,0 +1,199 @@
+"""Process-wide byte-budgeted LRU cache of DECODED chunks.
+
+The chunk decode path re-reads the same compressed chunks for every
+overlapping output box: fusion halos (adjacent compute blocks expand by a
+voxel), detection blocks (2*halo overlap per block edge), downsample
+pyramids (every level re-reads its parent), and repeated runs over the
+same inputs all decode identical chunks again. This cache sits under
+``Dataset.read`` for every driver (native N5 codec, tensorstore, h5py) so
+each chunk decodes ONCE per process while the budget holds.
+
+Keys are ``(dataset_key, meta_sig, chunk_pos)``:
+
+- ``dataset_key`` = (store root, dataset path) — content-addressed, so
+  independent ``Dataset``/``ChunkStore`` instances over the same on-disk
+  array SHARE entries (cross-reader sharing);
+- ``meta_sig`` = the dataset metadata file's (mtime_ns, size) signature
+  (the same signature ``Dataset._meta_file_cached`` keys on) — recreating
+  a dataset at the same path orphans the old entries;
+- ``chunk_pos`` = the chunk's grid position.
+
+Writes invalidate: ``Dataset.write`` drops exactly the chunk positions the
+written box covers (any signature), and store-level remove/recreate drops
+every entry under the path prefix. Each invalidation also bumps a
+per-dataset GENERATION counter that device-side caches (the composite
+fusion tile cache) fold into their keys, so host-visible mutation
+propagates to HBM-resident copies too.
+
+``BST_CHUNK_CACHE_BYTES`` sets the budget (default 1 GiB); ``0`` disables
+caching entirely — reads then take exactly the pre-cache code paths, so
+cache-off output is bit-identical by construction. Only process-coherent
+stores are cached (local filesystems, ``memory://`` roots, single-process
+HDF5); remote object stores (s3/gs) are not, because another process can
+mutate them without any host-visible signal.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..observe import metrics as _metrics
+
+DEFAULT_BUDGET = 1 << 30
+
+_HITS = _metrics.counter("bst_chunk_cache_hits_total")
+_MISSES = _metrics.counter("bst_chunk_cache_misses_total")
+_HIT_BYTES = _metrics.counter("bst_chunk_cache_hit_bytes_total")
+_MISS_BYTES = _metrics.counter("bst_chunk_cache_miss_bytes_total")
+_EVICTIONS = _metrics.counter("bst_chunk_cache_evictions_total")
+_EVICT_BYTES = _metrics.counter("bst_chunk_cache_evict_bytes_total")
+_INVALIDATIONS = _metrics.counter("bst_chunk_cache_invalidations_total")
+_CUR_BYTES = _metrics.gauge("bst_chunk_cache_bytes")
+_CUR_ENTRIES = _metrics.gauge("bst_chunk_cache_entries")
+
+
+def budget_bytes() -> int:
+    """Current byte budget (read from the environment on every call so
+    tests and long-lived processes can retune without restarting)."""
+    raw = os.environ.get("BST_CHUNK_CACHE_BYTES")
+    if raw is None or raw == "":
+        return DEFAULT_BUDGET
+    try:
+        return max(0, int(float(raw)))
+    except ValueError:
+        return DEFAULT_BUDGET
+
+
+def enabled() -> bool:
+    return budget_bytes() > 0
+
+
+class ChunkCache:
+    """Thread-safe byte-budgeted LRU over decoded chunk arrays.
+
+    Stored arrays are private contiguous copies marked read-only; readers
+    always copy out of them into their own output buffers, so a cached
+    chunk can never alias caller-visible memory."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, np.ndarray] = OrderedDict()
+        self._by_dataset: dict[tuple, set] = {}
+        self._generations: dict[tuple, int] = {}
+        self._bytes = 0
+
+    # -- lookup ------------------------------------------------------------
+
+    def get(self, key: tuple) -> np.ndarray | None:
+        with self._lock:
+            arr = self._entries.get(key)
+            if arr is None:
+                _MISSES.inc()
+                return None
+            self._entries.move_to_end(key)
+        _HITS.inc()
+        _HIT_BYTES.inc(arr.nbytes)
+        return arr
+
+    def put(self, key: tuple, arr: np.ndarray) -> None:
+        budget = budget_bytes()
+        if arr.nbytes > budget:
+            _MISS_BYTES.inc(arr.nbytes)
+            return
+        arr = np.ascontiguousarray(arr)
+        arr.setflags(write=False)
+        evicted = []
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = arr
+            self._by_dataset.setdefault(key[0], set()).add(key)
+            self._bytes += arr.nbytes
+            while self._bytes > budget and self._entries:
+                k, v = self._entries.popitem(last=False)
+                self._by_dataset.get(k[0], set()).discard(k)
+                self._bytes -= v.nbytes
+                evicted.append(v.nbytes)
+            self._update_gauges()
+        _MISS_BYTES.inc(arr.nbytes)
+        for nb in evicted:
+            _EVICTIONS.inc()
+            _EVICT_BYTES.inc(nb)
+
+    # -- invalidation ------------------------------------------------------
+
+    def invalidate(self, dataset_key: tuple,
+                   chunk_positions=None) -> None:
+        """Drop a dataset's entries (all of them, or only the listed chunk
+        positions — any metadata signature) and bump its generation.
+
+        Runs even when caching is disabled: the generation counter is how
+        device-side caches observe writes, and it must advance regardless
+        of whether host chunks were retained."""
+        with self._lock:
+            self._generations[dataset_key] = (
+                self._generations.get(dataset_key, 0) + 1)
+            keys = self._by_dataset.get(dataset_key)
+            if not keys:
+                return
+            if chunk_positions is None:
+                doomed = list(keys)
+            else:
+                wanted = {tuple(int(v) for v in p) for p in chunk_positions}
+                doomed = [k for k in keys if k[2] in wanted]
+            for k in doomed:
+                v = self._entries.pop(k, None)
+                keys.discard(k)
+                if v is not None:
+                    self._bytes -= v.nbytes
+                    _INVALIDATIONS.inc()
+            if not keys:
+                self._by_dataset.pop(dataset_key, None)
+            self._update_gauges()
+
+    def invalidate_prefix(self, root, path_prefix: str) -> None:
+        """Drop every dataset under ``path_prefix`` of ``root`` (store-level
+        remove / recreate; an empty prefix clears the whole root)."""
+        prefix = path_prefix.strip("/")
+        with self._lock:
+            victims = [dk for dk in set(self._by_dataset)
+                       | set(self._generations)
+                       if dk[0] == root
+                       and (not prefix
+                            or dk[1].strip("/") == prefix
+                            or dk[1].strip("/").startswith(prefix + "/"))]
+        for dk in victims:
+            self.invalidate(dk)
+
+    def generation(self, dataset_key: tuple) -> int:
+        with self._lock:
+            return self._generations.get(dataset_key, 0)
+
+    # -- maintenance / introspection ---------------------------------------
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._by_dataset.clear()
+            self._bytes = 0
+            self._update_gauges()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes}
+
+    def _update_gauges(self) -> None:
+        _CUR_BYTES.set(self._bytes)
+        _CUR_ENTRIES.set(len(self._entries))
+
+
+_CACHE = ChunkCache()
+
+
+def get_cache() -> ChunkCache:
+    return _CACHE

@@ -25,7 +25,7 @@ from typing import Any, Sequence
 import numpy as np
 import tensorstore as ts
 
-from . import uris
+from . import chunkcache, uris
 from ..observe import events as _events
 from ..observe import metrics as _metrics
 
@@ -200,8 +200,195 @@ class Dataset:
         idx = tuple(slice(int(o), int(o) + int(s)) for o, s in zip(offset, shape))
         return idx[::-1] if self.reversed_axes else idx
 
+    # -- decoded-chunk cache plumbing (io.chunkcache) ----------------------
+
+    def _cache_key(self) -> tuple:
+        root = getattr(self.store, "root", None)
+        if root is None:
+            root = getattr(self.store, "path", None)
+        return (root, self.path.strip("/"))
+
+    def _cacheable(self) -> bool:
+        """Only process-coherent stores participate: local filesystems,
+        in-process ``memory://`` roots, and single-process HDF5. Remote
+        object stores can change under another process with no
+        host-visible signal, so they bypass the cache."""
+        store = self.store
+        if store is None:
+            return False
+        if getattr(store, "format", None) == StorageFormat.HDF5:
+            return True
+        return bool(getattr(store, "is_local", False)
+                    or str(getattr(store, "root", "")
+                           ).startswith("memory://"))
+
+    def _cache_sig(self):
+        """Metadata-file signature folded into cache keys — the same
+        (mtime_ns, size) identity ``_meta_file_cached`` uses, so an
+        out-of-band recreate at this path orphans the old entries."""
+        store = self.store
+        if not getattr(store, "is_local", False) or not hasattr(store, "_kvpath"):
+            return None
+        name = ("attributes.json"
+                if getattr(store, "format", None) == StorageFormat.N5
+                else ".zarray")
+        try:
+            st = os.stat(os.path.join(store._kvpath(self.path), name))
+            return (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None
+
+    def _cached_read(self, offset: Sequence[int],
+                     shape: Sequence[int]) -> np.ndarray | None:
+        """Assemble a box from cached decoded chunks, decoding only the
+        misses. Returns None when ineligible (out-of-bounds box,
+        unchunked dataset, no usable decode route) — the caller then runs
+        the exact pre-cache read path."""
+        try:
+            block = self.block_size
+        except Exception:
+            return None
+        if not block or any(int(b) <= 0 for b in block):
+            return None
+        dims = self.shape
+        ndim = len(dims)
+        off = [int(o) for o in offset]
+        shp = [int(s) for s in shape]
+        if len(off) != ndim or len(shp) != ndim:
+            return None
+        if any(o < 0 or s <= 0 or o + s > dims[d]
+               for d, (o, s) in enumerate(zip(off, shp))):
+            return None
+        cc = chunkcache.get_cache()
+        dkey = self._cache_key()
+        sig = self._cache_sig()
+        out = np.empty(tuple(shp), self.dtype)
+        import itertools
+
+        grids = [range(off[d] // block[d],
+                       (off[d] + shp[d] - 1) // block[d] + 1)
+                 for d in range(ndim)]
+        copied = {"cache": 0}
+
+        def fill(pos, chunk) -> int:
+            lo = [pos[d] * block[d] for d in range(ndim)]
+            src = tuple(
+                slice(max(off[d] - lo[d], 0),
+                      min(off[d] + shp[d] - lo[d], chunk.shape[d]))
+                for d in range(ndim))
+            dst = tuple(
+                slice(max(lo[d] - off[d], 0),
+                      max(lo[d] - off[d], 0) + (src[d].stop - src[d].start))
+                for d in range(ndim))
+            out[dst] = chunk[src]
+            return int(out[dst].nbytes)
+
+        misses = []
+        for pos in itertools.product(*grids):
+            chunk = cc.get((dkey, sig, pos))
+            if chunk is None:
+                misses.append(pos)
+            else:
+                copied["cache"] += fill(pos, chunk)
+        if misses:
+            got = self._read_chunks(misses)
+            if got is None:
+                return None  # no decode route: fall back (and re-read hits)
+            via, chunks = got
+            nb = 0
+            for pos, chunk in zip(misses, chunks):
+                cc.put((dkey, sig, pos), chunk)
+                nb += fill(pos, chunk)
+            copied[via] = copied.get(via, 0) + nb
+        for via, nb in copied.items():
+            if nb:
+                _record_io("read", via, nb, self.path)
+        return out
+
+    def _read_chunks(self, positions):
+        """Decode whole chunks (clipped to the array bounds, logical
+        xyz-first orientation, absent chunks zero-filled). Returns
+        (via, [chunk, ...]) aligned with ``positions``, or None when no
+        decode route applies."""
+        block = self.block_size
+        dims = self.shape
+        ndim = len(dims)
+
+        def extent(pos):
+            return tuple(min(block[d], dims[d] - pos[d] * block[d])
+                         for d in range(ndim))
+
+        ctype = self._native_n5_eligible()
+        if ctype is not None:
+            from . import native_blockio
+
+            root = self.store._kvpath(self.path)
+
+            def read_one(pos):
+                path = os.path.join(root, *[str(p) for p in pos])
+                blk = native_blockio.read_block(path, self.dtype, block,
+                                                compression=ctype)
+                ext = extent(pos)
+                if blk is None:
+                    return np.zeros(ext, self.dtype)
+                if tuple(blk.shape) != ext:
+                    # stored chunk dims may be full-size at the array edge
+                    clipped = np.zeros(ext, self.dtype)
+                    sl = tuple(slice(0, min(blk.shape[d], ext[d]))
+                               for d in range(ndim))
+                    clipped[sl] = blk[sl]
+                    return clipped
+                return blk
+
+            if len(positions) > 1:
+                return "native", list(_decode_pool().map(read_one, positions))
+            return "native", [read_one(positions[0])]
+        if self._ts is None:
+            return None
+        sels = []
+        for pos in positions:
+            lo = [pos[d] * block[d] for d in range(ndim)]
+            sels.append(self._sel(lo, extent(pos)))
+        rev = tuple(range(ndim))[::-1]
+        if hasattr(self._ts, "read"):
+            # issue every chunk read before resolving any: tensorstore
+            # overlaps the decodes, so a miss burst costs one round of IO
+            futs = [self._ts[sel].read() for sel in sels]
+            chunks = [np.asarray(f.result()) for f in futs]
+            via = "tensorstore"
+        else:
+            chunks = [np.asarray(self._ts[sel]) for sel in sels]
+            via = "h5py"
+        if self.reversed_axes:
+            chunks = [c.transpose(rev) for c in chunks]
+        return via, chunks
+
+    def _invalidate_box(self, offset: Sequence[int],
+                        shape: Sequence[int]) -> None:
+        """Drop the cached chunks a written box covers (and bump the
+        dataset generation device-side caches key on)."""
+        try:
+            block = self.block_size
+        except Exception:
+            chunkcache.get_cache().invalidate(self._cache_key())
+            return
+        if not block or any(int(b) <= 0 for b in block):
+            chunkcache.get_cache().invalidate(self._cache_key())
+            return
+        import itertools
+
+        grids = [range(int(offset[d]) // block[d],
+                       (int(offset[d]) + int(shape[d]) - 1) // block[d] + 1)
+                 for d in range(len(block))]
+        chunkcache.get_cache().invalidate(self._cache_key(),
+                                          itertools.product(*grids))
+
     def read(self, offset: Sequence[int], shape: Sequence[int]) -> np.ndarray:
         """Read a box (xyz-first offset/shape) into a numpy array (xyz-first)."""
+        if chunkcache.enabled() and self._cacheable():
+            cached = self._cached_read(offset, shape)
+            if cached is not None:
+                return cached
         native = self._native_read(offset, shape)
         if native is not None:
             _record_io("read", "native", native.nbytes, self.path)
@@ -292,23 +479,30 @@ class Dataset:
         Block-aligned N5 and zarr writes take the native codec fast path
         (GIL-free strided copy + zstd encode + file write,
         io.native_blockio) when available."""
-        if self._native_write(data, offset) or self._native_write_zarr(data, offset):
-            _record_io("write", "native", data.nbytes, self.path)
-            return
-        if self._ts is None:
-            raise ValueError(
-                f"{self.path}: native-only dataset (lz4) — writes must be "
-                "block-aligned and dtype-matched")
-        sel = self._sel(offset, data.shape)
-        if self.reversed_axes:
-            data = data.transpose(tuple(range(data.ndim))[::-1])
-        if hasattr(self._ts, "read"):
-            self._ts[sel].write(np.ascontiguousarray(data)).result()
-            via = "tensorstore"
-        else:
-            self._ts[sel] = data
-            via = "h5py"
-        _record_io("write", via, data.nbytes, self.path)
+        shape = data.shape
+        try:
+            if (self._native_write(data, offset)
+                    or self._native_write_zarr(data, offset)):
+                _record_io("write", "native", data.nbytes, self.path)
+                return
+            if self._ts is None:
+                raise ValueError(
+                    f"{self.path}: native-only dataset (lz4) — writes must "
+                    "be block-aligned and dtype-matched")
+            sel = self._sel(offset, data.shape)
+            if self.reversed_axes:
+                data = data.transpose(tuple(range(data.ndim))[::-1])
+            if hasattr(self._ts, "read"):
+                self._ts[sel].write(np.ascontiguousarray(data)).result()
+                via = "tensorstore"
+            else:
+                self._ts[sel] = data
+                via = "h5py"
+            _record_io("write", via, data.nbytes, self.path)
+        finally:
+            # drop exactly the cached chunks this box covers (finally: a
+            # partially-applied failed write must not leave stale entries)
+            self._invalidate_box(offset, shape)
 
     def _native_n5_eligible(self) -> str | None:
         """Shared native-codec eligibility gate for N5 reads AND writes:
@@ -594,6 +788,7 @@ class ChunkStore:
         compression_level: int | None = None,
     ) -> Dataset:
         """Create a chunked dataset. ``shape``/``block_size`` xyz-first."""
+        chunkcache.get_cache().invalidate_prefix(self.root, path)
         dtype = np.dtype(dtype).name
         if dtype not in _N5_DTYPES:
             raise ValueError(f"unsupported dtype {dtype}")
@@ -715,6 +910,7 @@ class ChunkStore:
         return len(keys) > 0
 
     def remove(self, path: str = "") -> None:
+        chunkcache.get_cache().invalidate_prefix(self.root, path)
         if self.is_local:
             p = self._kvpath(path) if path else self.root
             if os.path.exists(p):
@@ -795,6 +991,7 @@ class Hdf5Store:
     ) -> Dataset:
         shape = tuple(int(v) for v in shape)
         block = tuple(min(int(b), int(s)) for b, s in zip(block_size, shape))
+        chunkcache.get_cache().invalidate_prefix(self.path, path)
         if delete_existing and path in self._f:
             del self._f[path]
         kw = {}

@@ -34,6 +34,12 @@ from ..observe import metrics as _metrics
 
 _H2D_BYTES = _metrics.counter("bst_xfer_h2d_bytes_total")
 _D2H_BYTES = _metrics.counter("bst_xfer_d2h_bytes_total")
+_H2D_SAVED = _metrics.counter("bst_xfer_h2d_bytes_saved_total")
+_D2H_SAVED = _metrics.counter("bst_xfer_d2h_bytes_saved_total")
+_TILE_HITS = _metrics.counter("bst_tile_cache_hits_total")
+_TILE_MISSES = _metrics.counter("bst_tile_cache_misses_total")
+_TILE_HIT_BYTES = _metrics.counter("bst_tile_cache_hit_bytes_total")
+_TILE_EVICT_BYTES = _metrics.counter("bst_tile_cache_evict_bytes_total")
 
 
 @dataclass
@@ -414,10 +420,23 @@ def plan_composite_volume(
     nbytes = sum(int(np.prod(s)) * isz for s, isz in zip(shapes, itemsizes))
     # device residency: tiles + the kernel's full-volume f32 accumulators
     # (acc + wsum + converted output ~= 3x) must fit the budget, or the
-    # caller falls back to the per-block path (fuse_grid_block loop)
+    # caller falls back to the per-block path (fuse_grid_block loop).
+    # Cached tiles of OTHER datasets/generations also occupy HBM — but a
+    # cache must never lock a fitting plan out of the fast path, so
+    # foreign residents are EVICTED (LRU-first) to make room rather than
+    # counted against the plan (this plan's own cached tiles are the very
+    # buffers `nbytes` already prices).
     nbytes += 3 * int(np.prod(bbox.shape)) * 4
     if nbytes > DEVICE_TILE_BUDGET_BYTES:
         return None
+    own_keys = {k for k in (_tile_cache_key(loader.open(p.view, 0))
+                            for p in plans) if k is not None}
+    with _TILE_CACHE_LOCK:
+        for k in [k for k in _TILE_CACHE if k not in own_keys]:
+            if (nbytes + _TILE_CACHE_BYTES[0]
+                    <= DEVICE_TILE_BUDGET_BYTES):
+                break
+            _tile_cache_drop_locked(k)
 
     out_shape = tuple(bbox.shape)
     io_ceil = tuple(int(np.ceil(max(0.0, o))) for o in
@@ -476,14 +495,96 @@ def plan_composite_volume(
                          coeffs, coeff_affs, tuple(kinds), diags, offs)
 
 
+# Cross-call device residency for composite-path tiles: repeated fusions
+# over the same stored views (best-of bench reps, the --masks double pass,
+# parameter sweeps) re-shipped identical tiles up a 70 MB/s wire every
+# call. Keys fold the dataset's chunk-cache identity, metadata signature
+# AND write-generation (io.chunkcache bumps it on every Dataset.write /
+# remove / recreate), so any host-visible mutation orphans the HBM copy;
+# orphaned generations of a dataset are purged eagerly when its current
+# generation uploads, not just under LRU pressure.
+import threading as _threading
+from collections import OrderedDict as _OrderedDict
+
+_TILE_CACHE: "_OrderedDict[tuple, object]" = _OrderedDict()
+_TILE_CACHE_LOCK = _threading.Lock()
+_TILE_CACHE_BYTES = [0]
+
+
+def _tile_cache_budget() -> int:
+    raw = __import__("os").environ.get("BST_TILE_CACHE_BYTES")
+    if raw is None or raw == "":
+        return int(2e9)
+    try:
+        return max(0, int(float(raw)))
+    except ValueError:
+        return int(2e9)
+
+
+def _tile_cache_key(ds) -> tuple | None:
+    """Stable content identity of a stored tile, or None when the dataset
+    has no cacheable identity (wrapper datasets, remote stores)."""
+    from ..io import chunkcache
+
+    if not (hasattr(ds, "_cache_key") and hasattr(ds, "_cacheable")):
+        return None
+    if not ds._cacheable():
+        return None
+    dkey = ds._cache_key()
+    return (*dkey, ds._cache_sig(), chunkcache.get_cache().generation(dkey))
+
+
+def _tile_cache_drop_locked(key) -> None:
+    v = _TILE_CACHE.pop(key, None)
+    if v is not None:
+        _TILE_CACHE_BYTES[0] -= int(v.nbytes)
+        _TILE_EVICT_BYTES.inc(int(v.nbytes))
+
+
 def upload_composite_tiles(loader, cp: CompositePlan) -> list:
-    """Stage the plan's tiles in HBM (async device_put per tile)."""
+    """Stage the plan's tiles in HBM (async device_put per tile), serving
+    unchanged tiles from the device-resident cache
+    (``BST_TILE_CACHE_BYTES`` budget, 0 disables)."""
     import jax
 
+    budget = _tile_cache_budget()
+    tiles = []
     with profiling.span("fusion.h2d_tiles"):
-        tiles = [jax.device_put(loader.open(p.view, 0).read_full())
-                 for p in cp.plans]
-        _H2D_BYTES.inc(sum(int(t.nbytes) for t in tiles))
+        h2d = saved = 0
+        for p in cp.plans:
+            ds = loader.open(p.view, 0)
+            key = _tile_cache_key(ds) if budget > 0 else None
+            if key is not None:
+                with _TILE_CACHE_LOCK:
+                    ent = _TILE_CACHE.get(key)
+                    if ent is not None:
+                        _TILE_CACHE.move_to_end(key)
+                if ent is not None:
+                    _TILE_HITS.inc()
+                    _TILE_HIT_BYTES.inc(int(ent.nbytes))
+                    tiles.append(ent)
+                    continue
+            arr = ds.read_full()
+            t = jax.device_put(arr)
+            h2d += int(t.nbytes)
+            if arr.dtype.kind in "iu" and arr.dtype.itemsize < 4:
+                saved += arr.size * 4 - arr.nbytes  # vs a float32 upload
+            if key is not None:
+                _TILE_MISSES.inc()
+                with _TILE_CACHE_LOCK:
+                    # purge write-orphaned generations of this dataset NOW
+                    # (they could otherwise pin dead HBM until LRU pressure)
+                    for stale in [k for k in _TILE_CACHE
+                                  if k[:2] == key[:2] and k != key]:
+                        _tile_cache_drop_locked(stale)
+                    if int(t.nbytes) <= budget:  # oversize: never resident
+                        _TILE_CACHE[key] = t
+                        _TILE_CACHE_BYTES[0] += int(t.nbytes)
+                        while _TILE_CACHE_BYTES[0] > budget and len(_TILE_CACHE) > 1:
+                            _tile_cache_drop_locked(next(iter(_TILE_CACHE)))
+            tiles.append(t)
+        _H2D_BYTES.inc(h2d)
+        _H2D_SAVED.inc(saved)
         return tiles
 
 
@@ -568,6 +669,10 @@ def _drain_device_volume(out, out_ds, zarr_ct, io_threads=4):
         with profiling.span("fusion.d2h"):
             data = np.asarray(slab)
             _D2H_BYTES.inc(data.nbytes)
+            if data.dtype.kind in "iu" and data.dtype.itemsize < 4:
+                # output converted to storage dtype ON DEVICE: the wire
+                # carries uint16/uint8, not the kernel's float32
+                _D2H_SAVED.inc(data.size * 4 - data.nbytes)
         with profiling.span("fusion.write"):
             if zarr_ct is not None:
                 c, t = zarr_ct
@@ -671,9 +776,14 @@ def _fuse_volume_sharded(
                 return arrs
 
             def kernel_call(*stacked):
+                # dispatch only — return the DEVICE array and let the work
+                # loop's jax.device_get fetch it, so the early-dispatch
+                # window actually overlaps compute with this batch's D2H
+                # (a blocking np.asarray here serialized the pipeline,
+                # ADVICE r5); wsum is dropped on device, never fetched
                 with profiling.span("fusion.kernel"):
                     out, _wsum = fuser(mi, ma, *stacked)
-                    return np.asarray(out)
+                    return out
 
             written: dict[tuple, int] = {}
 
@@ -701,6 +811,10 @@ def _fuse_volume_sharded(
                 items, build, kernel_call, consume, n_dev, pool,
                 label=f"fusion batch {key}", progress=progress,
                 per_dev=per_dev,
+                # device-resident per item: converted block + f32 wsum
+                out_bytes_per_item=int(np.prod(compute_block))
+                * (np.dtype(out_dtype or "float32").itemsize + 4),
+                workspace_mult=3.0,
             )
             stats.voxels += sum(written.values())
     finally:

@@ -435,7 +435,10 @@ def detect_interest_points(
             rel_vol = int(np.prod(rel))
             run_sharded_batches(bjobs, build, kernel_fn, consume, n_dev, pool,
                                 label="detection batch",
-                                per_dev=max(1, per_dev // rel_vol))
+                                per_dev=max(1, per_dev // rel_vol),
+                                # DoG expands the native-dtype input to
+                                # several pooled f32 volumes on device
+                                workspace_mult=8.0)
     finally:
         pool.shutdown(wait=True)
 

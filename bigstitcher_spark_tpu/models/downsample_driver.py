@@ -98,16 +98,27 @@ def run_sharded_downsample(jobs, read_job, write_job, rel, devices=None,
     buckets: dict[tuple, list] = {}
     for job in jobs:
         buckets.setdefault(tuple(read_shape(job, rel)), []).append(job)
+    def build(job):
+        # ship the source box in its stored dtype — downsample_block casts
+        # to float32 ON DEVICE, so the host astype only doubled wire bytes
+        # (big-endian HDF5 blocks byteswap on host: JAX rejects them)
+        raw = read_job(job)
+        if raw.dtype.kind in "iu" and raw.dtype.itemsize < 4:
+            if raw.dtype.byteorder == ">":
+                raw = raw.astype(raw.dtype.newbyteorder("="))
+            return (raw,)
+        return (raw.astype(np.float32),)
+
     pool = ThreadPoolExecutor(max_workers=max(1, io_threads))
     try:
         for shp, items in sorted(buckets.items()):
+            out_vox = int(np.prod([s // int(f) for s, f in zip(shp, rel)]))
             run_sharded_batches(
-                items,
-                lambda job: (read_job(job).astype(np.float32),),
-                kernel,
-                write_job,
+                items, build, kernel, write_job,
                 n_dev, pool, label=label, per_dev=per_dev,
                 multihost=multihost,
+                out_bytes_per_item=out_vox * 4,  # f32 device output
+                workspace_mult=3.0,              # f32 cast of the input
             )
     finally:
         pool.shutdown(wait=True)

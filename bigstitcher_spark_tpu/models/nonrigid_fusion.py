@@ -237,7 +237,10 @@ def fuse_nonrigid_volume(
 
             run_sharded_batches(items, build, kernel_call, consume, n_dev,
                                 pool, label="nonrigid batch",
-                                progress=progress, multihost=True)
+                                progress=progress, multihost=True,
+                                out_bytes_per_item=int(np.prod(compute_block))
+                                * np.dtype(out_dtype or "float32").itemsize,
+                                workspace_mult=4.0)
             stats.voxels += sum(written.values())
     finally:
         pool.shutdown(wait=True)

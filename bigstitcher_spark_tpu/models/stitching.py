@@ -39,6 +39,10 @@ from ..utils.geometry import (
     translation_affine,
 )
 from .. import observe, profiling
+from ..observe import metrics as _metrics
+
+_H2D_BYTES = _metrics.counter("bst_xfer_h2d_bytes_total")
+_H2D_SAVED = _metrics.counter("bst_xfer_h2d_bytes_saved_total")
 
 
 @dataclass
@@ -378,10 +382,30 @@ def stitch_jobs(sd, jobs: list[_PairJob], params: StitchingParams
 
 
 def _as_uint16_lossless(stack: np.ndarray) -> np.ndarray | None:
-    """uint16 view of the stack when every value survives the round-trip
+    """uint16 copy of the stack when every value survives the round-trip
     exactly (integral, in range — single-channel stored-level crops), else
-    None. One astype pass + one compare; fractional/NaN/out-of-range
-    values fail the compare."""
+    None. NaN/inf/out-of-range values are rejected by a min/max pre-check
+    BEFORE the cast: casting them to uint16 is C-implementation-defined
+    and raises numpy 'invalid value encountered in cast' RuntimeWarnings
+    (ADVICE r5). Fractional in-range values cast quietly and fail the
+    equality check."""
+    if stack.dtype == np.uint16:
+        return stack
+    if stack.dtype.kind in "iu":
+        if stack.size == 0:
+            return stack.astype(np.uint16)
+        mn, mx = stack.min(), stack.max()
+        if mn < 0 or mx > np.iinfo(np.uint16).max:
+            return None
+        return stack.astype(np.uint16)
+    if stack.dtype.kind != "f":
+        return None
+    if stack.size == 0:
+        return stack.astype(np.uint16)
+    mn, mx = stack.min(), stack.max()  # min/max propagate NaN
+    if (not np.isfinite(mn) or not np.isfinite(mx)
+            or mn < 0 or mx > np.iinfo(np.uint16).max):
+        return None
     u = stack.astype(np.uint16)
     return u if np.array_equal(stack, u) else None
 
@@ -397,8 +421,10 @@ def _dispatch_bucket(jobs: list[_PairJob], shp, params):
     ub = _as_uint16_lossless(b) if ua is not None else None
     if ua is not None and ub is not None:
         a, b = ua, ub
+        _H2D_SAVED.inc(a.size * 4 - a.nbytes + b.size * 4 - b.nbytes)
     ext_a = np.stack([np.array(j.crop_a.shape, np.int32) for j in jobs])
     ext_b = np.stack([np.array(j.crop_b.shape, np.int32) for j in jobs])
+    _H2D_BYTES.inc(a.nbytes + b.nbytes + ext_a.nbytes + ext_b.nbytes)
     return pcm_peaks_batch(a, b, ext_a, ext_b, params.peaks_to_check, 0.25)
 
 

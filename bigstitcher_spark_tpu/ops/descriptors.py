@@ -210,23 +210,58 @@ def match_ratio_test(desc_a, owner_a, desc_b, owner_b, ratio,
     if da * db <= _TILE_ENTRIES:
         return _match_ratio_dense(desc_a, owner_a, desc_b, owner_b,
                                   jnp.float32(ratio))
-    desc_a = jnp.asarray(desc_a)
-    desc_b = jnp.asarray(desc_b)
-    owner_b = jnp.asarray(owner_b)
+    # one upload each, shared by every row chunk (numpy inputs used to ride
+    # up the wire once per chunk before the asarray hoist; device_put makes
+    # the single staging explicit and async)
+    desc_a = jax.device_put(desc_a)
+    desc_b = jax.device_put(desc_b)
+    owner_b = jax.device_put(owner_b)
     rb = _row_block(min(db, 1 << 16))
     cb = 1 << 14
     topk = max(8, max_owner_multiplicity + 2)
-    # dispatch every row chunk before fetching: outputs are small index
-    # tables, so all chunks' device programs queue back-to-back and one
-    # pipelined device_get drains them (no per-chunk round-trip)
-    devs = [
-        _match_ratio_row_chunk(desc_a[i:i + rb], desc_b, owner_b,
-                               jnp.float32(ratio), cb, topk)
-        for i in range(0, da, rb)
-    ]
-    got = jax.device_get(devs)
-    return (np.concatenate([o for o, _ in got]),
-            np.concatenate([a for _, a in got]))
+    # row chunks dispatch in BYTE-BUDGETED segments instead of all at once
+    # (unbounded dispatch pinned every chunk's row slice + scan workspace
+    # simultaneously, so device memory scaled with da/rb): each in-flight
+    # chunk pins its row slice, the (rb, cb) distance tile + top-k scan
+    # carry, and its output tables; segment k+1 dispatches before segment
+    # k drains — one pipelined device_get per segment, up to two segments
+    # resident — so the device never idles between segments
+    from ..utils.devicemem import InflightWindow, dispatch_budget_bytes
+
+    dim = int(desc_a.shape[1])
+    chunk_cost = (rb * dim * 4          # row slice copy
+                  + 2 * rb * cb * 4     # distance tile + masked variant
+                  + rb * (topk + cb) * 8)  # scan carry + top_k workspace
+    per_seg = max(1, int(dispatch_budget_bytes() // (2 * chunk_cost)))
+    window = InflightWindow()
+    starts = list(range(0, da, rb))
+    ratio32 = jnp.float32(ratio)
+    owners: list[np.ndarray] = []
+    accepts: list[np.ndarray] = []
+
+    def drain(seg):
+        try:
+            got = jax.device_get(seg)
+        finally:
+            # drained or dead, the buffers leave the ledger either way
+            window.release(chunk_cost * len(seg))
+        for o, a in got:
+            owners.append(o)
+            accepts.append(a)
+
+    prev = None
+    for s0 in range(0, len(starts), per_seg):
+        seg = []
+        for s in starts[s0:s0 + per_seg]:
+            seg.append(_match_ratio_row_chunk(desc_a[s:s + rb], desc_b,
+                                              owner_b, ratio32, cb, topk))
+            window.charge(chunk_cost)
+        if prev is not None:
+            drain(prev)
+        prev = seg
+    if prev is not None:
+        drain(prev)
+    return np.concatenate(owners), np.concatenate(accepts)
 
 
 def match_candidates(

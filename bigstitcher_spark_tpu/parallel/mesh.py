@@ -36,9 +36,23 @@ BLOCK_AXIS = "blocks"
 
 # host<->device transfer accounting (the tunnel/PCIe wire is the scarce
 # resource on remote accelerators — PERF.md §3h): stacked batch inputs are
-# the h2d side, fetched outputs the d2h side
+# the h2d side, fetched outputs the d2h side. The *_saved counters record
+# bytes the native-dtype transport kept OFF the wire versus shipping
+# float32 (uint8/uint16 stacks cast to f32 on device, integer outputs
+# converted to storage dtype on device) so artifacts can prove the
+# reduction without a counterfactual run.
 _H2D_BYTES = _metrics.counter("bst_xfer_h2d_bytes_total")
 _D2H_BYTES = _metrics.counter("bst_xfer_d2h_bytes_total")
+_H2D_SAVED = _metrics.counter("bst_xfer_h2d_bytes_saved_total")
+_D2H_SAVED = _metrics.counter("bst_xfer_d2h_bytes_saved_total")
+
+
+def narrow_dtype_savings(arrays) -> int:
+    """Wire bytes saved by shipping sub-float32-width integer arrays
+    natively instead of as the float32 the kernels compute in."""
+    return sum(a.size * 4 - a.nbytes for a in arrays
+               if getattr(a, "dtype", None) is not None
+               and a.dtype.kind in "iu" and a.dtype.itemsize < 4)
 
 
 @functools.lru_cache(maxsize=8)
@@ -157,6 +171,8 @@ def run_sharded_batches(
     progress: bool = False,
     per_dev: int = 1,
     multihost: bool = False,
+    out_bytes_per_item: int = 0,
+    workspace_mult: float = 2.0,
 ):
     """The shared multi-device work loop: every sharded stage driver (fusion,
     detection, nonrigid, downsample) is this pattern — the TPU replacement of
@@ -171,15 +187,22 @@ def run_sharded_batches(
     needed, the reference's no-shuffle invariant).
 
     Host prefetch for batch k+1 overlaps device compute for batch k, and
-    when batch k+1's inputs are already staged its program is dispatched
-    BEFORE batch k's outputs are fetched — the device computes k+1 while
-    k's outputs cross the wire and write (device double buffering; up to
-    two batches' arrays resident). Batches are resubmitted on failure via
-    run_with_retry, and completed batches are tracked so retry rounds
-    neither re-run them nor leak prefetch futures; early-dispatched
-    results are keyed per batch and rebuilt on retry, so failure
-    granularity is unchanged. ``per_dev`` packs that many items per
-    device per batch (compute-light kernels amortize dispatch by
+    staged batches are dispatched AHEAD of batch k's fetch, as many as a
+    BYTE budget allows: each dispatch is charged real bytes — stacked
+    inputs x ``workspace_mult`` (kernel intermediates/FFT workspace) plus
+    ``out_bytes_per_item`` per item for device-resident outputs — against
+    the backend's free-memory budget (utils.devicemem: ``memory_stats``
+    when the runtime reports them, ``BST_INFLIGHT_BYTES`` override,
+    conservative constant otherwise). The device computes ahead while
+    outputs cross the wire and write; a window that does not fit stops
+    growing, and the CURRENT batch always dispatches so progress never
+    blocks (``BST_EARLY_DISPATCH=0`` opts out of dispatch-ahead entirely,
+    degenerating to strict one-batch-at-a-time). Batches are resubmitted
+    on failure via run_with_retry, and completed batches are tracked so
+    retry rounds neither re-run them nor leak prefetch futures;
+    early-dispatched results are keyed per batch and rebuilt on retry, so
+    failure granularity is unchanged. ``per_dev`` packs that many items
+    per device per batch (compute-light kernels amortize dispatch by
     batching more).
 
     ``multihost=True`` (block-writing stages only — outputs must be disjoint
@@ -192,15 +215,22 @@ def run_sharded_batches(
         from .distributed import partition_items
 
         items = partition_items(items)
+    from ..utils.devicemem import InflightWindow
+
     group = n_dev * max(1, per_dev)
     batches = [list(items[i:i + group]) for i in range(0, len(items), group)]
     if not batches:
         return
+    window = InflightWindow()
     prefetched = {0: [pool.submit(build, it) for it in batches[0]]}
-    dispatched: dict[int, tuple] = {}
+    dispatched: dict[int, tuple] = {}   # bi -> (outs, charged bytes)
     completed: set[int] = set()
 
-    def stack_and_dispatch(inputs):
+    def batch_cost(input_bytes: int, n_items: int) -> int:
+        return (int(input_bytes * max(workspace_mult, 1.0))
+                + n_items * int(out_bytes_per_item))
+
+    def stack_and_dispatch(inputs, n_items):
         # pad to a multiple of n_dev (the sharding constraint), NOT to the
         # full group size: a tail batch of 4 on 1 device must not run as 8
         # blocks of which half are zero work (the jit re-specializes once
@@ -210,51 +240,92 @@ def run_sharded_batches(
              for j in range(len(inputs[0]))],
             -(-len(inputs) // max(n_dev, 1)) * max(n_dev, 1),
         )
-        _H2D_BYTES.inc(sum(a.nbytes for a in stacked))
+        nbytes = sum(a.nbytes for a in stacked)
+        _H2D_BYTES.inc(nbytes)
+        _H2D_SAVED.inc(narrow_dtype_savings(stacked))
         outs = kernel(*stacked)
-        return outs if isinstance(outs, (tuple, list)) else (outs,)
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        cost = batch_cost(nbytes, n_items)
+        window.charge(cost)
+        return outs, cost
+
+    def dispatch_ahead(bi):
+        """Dispatch every staged later batch that fits the byte budget, so
+        the device computes ahead while batch ``bi`` drains; keep host
+        prefetch one batch past the dispatch frontier."""
+        if os.environ.get("BST_EARLY_DISPATCH", "1") != "1":
+            # opting out of dispatch-ahead must NOT kill host-side build
+            # prefetch — the next batch still stages while this one drains
+            nxt = bi + 1
+            if (nxt < len(batches) and nxt not in prefetched
+                    and nxt not in dispatched and nxt not in completed):
+                prefetched[nxt] = [pool.submit(build, it)
+                                   for it in batches[nxt]]
+            return
+        for j in range(bi + 1, len(batches)):
+            if j in completed or j in dispatched:
+                continue
+            futs = prefetched.get(j)
+            if futs is None:
+                # stage TWO batches deep: j's futures are checked next
+                # turn, so without j+1 already building the check would
+                # always land on a just-submitted batch and the window
+                # could never grow past one
+                for k in (j, j + 1):
+                    if (k < len(batches) and k not in prefetched
+                            and k not in dispatched and k not in completed):
+                        prefetched[k] = [pool.submit(build, it)
+                                         for it in batches[k]]
+                return
+            if not all(f.done() for f in futs):
+                return
+            if any(f.exception() is not None for f in futs):
+                # a build error belongs to batch j: its own process_batch
+                # re-stages and raises so retry accounting blames it
+                return
+            est = batch_cost(sum(sum(int(a.nbytes) for a in f.result())
+                                 for f in futs), len(batches[j]))
+            if not window.fits(est):
+                return
+            del prefetched[j]
+            try:
+                dispatched[j] = stack_and_dispatch(
+                    [f.result() for f in futs], len(batches[j]))
+            except Exception:
+                # stacking/dispatch error: same blame rule as above
+                return
+            nxt = j + 1
+            if (nxt < len(batches) and nxt not in prefetched
+                    and nxt not in dispatched and nxt not in completed):
+                prefetched[nxt] = [pool.submit(build, it)
+                                   for it in batches[nxt]]
 
     def process_batch(bi_batch):
         bi, batch = bi_batch
         if bi in completed:
             return
-        outs = dispatched.pop(bi, None)
-        if outs is None:
+        ent = dispatched.pop(bi, None)
+        if ent is None:
             futs = prefetched.pop(bi, None)
             if futs is None:  # retry round: prefetch again
                 futs = [pool.submit(build, it) for it in batch]
-            outs = stack_and_dispatch([f.result() for f in futs])
-        nxt = bi + 1
-        if nxt < len(batches) and nxt not in completed:
-            if nxt not in prefetched and nxt not in dispatched:
-                prefetched[nxt] = [pool.submit(build, it) for it in batches[nxt]]
-            futs = prefetched.get(nxt)
-            # BST_EARLY_DISPATCH=0 opts out: early dispatch keeps up to
-            # TWO batches' arrays resident (2x the per_dev budget callers
-            # size for), which matters only when BST_PER_DEV_BUDGET is
-            # pushed toward HBM capacity
-            if (futs is not None and all(f.done() for f in futs)
-                    and os.environ.get("BST_EARLY_DISPATCH", "1") == "1"):
-                # next batch's inputs are staged: put its program on the
-                # device stream NOW so it computes while this batch's
-                # outputs cross the wire and write (the fetch below only
-                # waits on THIS batch's buffers — a data dependency)
-                del prefetched[nxt]
-                try:
-                    dispatched[nxt] = stack_and_dispatch(
-                        [f.result() for f in futs])
-                except Exception:
-                    # a build/dispatch error belongs to batch nxt, not to
-                    # this one: let nxt's own process_batch re-stage and
-                    # raise it so retry accounting blames the right batch
-                    pass
-                nxt2 = nxt + 1
-                if (nxt2 < len(batches) and nxt2 not in prefetched
-                        and nxt2 not in completed):
-                    prefetched[nxt2] = [pool.submit(build, it)
-                                        for it in batches[nxt2]]
-        outs = jax.device_get(list(outs))  # pipelined multi-output fetch
+            # the CURRENT batch dispatches regardless of the window budget
+            # (forward progress must never block on the ledger)
+            ent = stack_and_dispatch([f.result() for f in futs], len(batch))
+        outs, cost = ent
+        # grow the in-flight window BEFORE fetching: the device computes
+        # ahead while this batch's outputs cross the wire and write (the
+        # fetch below only waits on THIS batch's buffers — a data
+        # dependency)
+        dispatch_ahead(bi)
+        try:
+            outs = jax.device_get(list(outs))  # pipelined multi-output fetch
+        finally:
+            # drained or dead, the buffers leave the ledger either way —
+            # a fetch error must not shrink the window for the whole run
+            window.release(cost)
         _D2H_BYTES.inc(sum(int(getattr(o, "nbytes", 0)) for o in outs))
+        _D2H_SAVED.inc(narrow_dtype_savings(outs))
         wfuts = [
             pool.submit(consume, it, *(o[i] for o in outs))
             for i, it in enumerate(batch)
@@ -266,7 +337,11 @@ def run_sharded_batches(
             observe.log(f"  {label}: batch {bi + 1}/{len(batches)} done",
                         stage=label)
 
-    run_with_retry(list(enumerate(batches)), process_batch, label=label)
+    try:
+        run_with_retry(list(enumerate(batches)), process_batch, label=label)
+    finally:
+        for _outs, cost in dispatched.values():
+            window.release(cost)  # keep the process-wide gauge honest
 
 
 def shard_jit(fn, mesh: Mesh, n_in: int, n_repl: int = 0, n_out=None,

@@ -1,0 +1,88 @@
+"""Device-memory budget for in-flight dispatch windows.
+
+Every multi-dispatch driver (parallel/mesh.run_sharded_batches, the tiled
+descriptor matcher, the segmented stitching drain) bounds how many programs
+it keeps in flight by BYTES — inputs + outputs + a workspace multiplier —
+instead of a fixed batch count: a fixed window sized for one block shape
+either under-fills small problems or OOMs big ones. The budget derives
+from the backend's real memory stats when the runtime exposes them
+(TPU/GPU PJRT ``memory_stats``), with ``BST_INFLIGHT_BYTES`` as the
+explicit override and a conservative constant for backends (XLA:CPU) that
+report nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..observe import metrics as _metrics
+
+# fallback when the backend reports no memory stats: two batches at the
+# historical 1e9 per-device staging budget (the pre-window heuristic kept
+# at most two batches resident — see BST_PER_DEV_BUDGET in the fusion
+# driver), so CPU behavior matches the old fixed double-buffering
+DEFAULT_BUDGET = int(2e9)
+
+# of the device memory the runtime says is free, keep this fraction for
+# in-flight dispatch work; the rest covers compiled-program workspace the
+# estimate cannot see
+_FREE_FRACTION = 0.6
+
+_INFLIGHT = _metrics.gauge("bst_inflight_bytes")
+_HIGHWATER = _metrics.gauge("bst_inflight_bytes_highwater")
+_LOCK = threading.Lock()
+
+
+def dispatch_budget_bytes() -> int:
+    """Byte budget for dispatched-but-not-drained device work.
+
+    ``BST_INFLIGHT_BYTES`` wins when set; otherwise the first local
+    device's ``memory_stats`` (free = limit - in_use) scaled by a safety
+    fraction; otherwise ``DEFAULT_BUDGET``."""
+    env = os.environ.get("BST_INFLIGHT_BYTES")
+    if env:
+        try:
+            return max(0, int(float(env)))
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            free = limit - int(stats.get("bytes_in_use", 0))
+            return max(256 << 20, int(_FREE_FRACTION * free))
+    except Exception:
+        pass
+    return DEFAULT_BUDGET
+
+
+class InflightWindow:
+    """Byte ledger for one driver's in-flight dispatches.
+
+    ``charge``/``release`` keep a per-window total and feed the
+    process-wide current/high-water gauges, so artifacts record how close
+    the window ran to its budget."""
+
+    def __init__(self, budget: int | None = None):
+        self.budget = dispatch_budget_bytes() if budget is None else budget
+        self.inflight = 0
+
+    def fits(self, nbytes: int) -> bool:
+        """Whether one more dispatch of ``nbytes`` stays inside the budget.
+        An empty window always fits (forward progress must never block)."""
+        return self.inflight == 0 or self.inflight + nbytes <= self.budget
+
+    def charge(self, nbytes: int) -> None:
+        self.inflight += nbytes
+        with _LOCK:
+            _INFLIGHT.inc(nbytes)
+            cur = _INFLIGHT.value
+            if cur > _HIGHWATER.value:
+                _HIGHWATER.set(cur)
+
+    def release(self, nbytes: int) -> None:
+        self.inflight = max(0, self.inflight - nbytes)
+        _INFLIGHT.inc(-nbytes)

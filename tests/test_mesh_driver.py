@@ -110,3 +110,93 @@ class TestRunShardedBatches:
 
         with pytest.raises(RetryError):
             self._run(2, consume)
+
+
+class TestInflightWindow:
+    """The byte-budgeted dispatch window (BST_INFLIGHT_BYTES /
+    utils.devicemem): the ledger must never exceed budget + one batch
+    (the current batch always dispatches), a generous budget must let the
+    loop run multiple batches ahead, and a starved budget must degrade to
+    strict one-batch-at-a-time without losing items."""
+
+    def _run(self, n_items, consume, build=None, per_dev=1):
+        from bigstitcher_spark_tpu.utils import devicemem
+
+        devicemem._HIGHWATER.set(0)
+        devicemem._INFLIGHT.set(0)
+        items = list(range(n_items))
+        build = build or (
+            lambda it: (np.full((1024,), float(it), np.float32),))
+        with ThreadPoolExecutor(4) as pool:
+            run_sharded_batches(
+                items, build=build, kernel=jax.jit(_kernel), consume=consume,
+                n_dev=1, pool=pool, per_dev=per_dev, workspace_mult=1.0,
+            )
+        return devicemem._HIGHWATER.value
+
+    def test_highwater_never_exceeds_budget_plus_current(self, monkeypatch):
+        batch_bytes = 1024 * 4                         # one item per batch
+        monkeypatch.setenv("BST_EARLY_DISPATCH", "1")
+        monkeypatch.setenv("BST_INFLIGHT_BYTES", str(2 * batch_bytes))
+        got = {}
+        import time
+
+        def consume(it, out):
+            time.sleep(0.02)   # give later builds time to stage
+            got[it] = np.asarray(out).copy()
+
+        hw = self._run(8, consume)
+        assert sorted(got) == list(range(8))
+        # budget (2 batches) + the always-dispatched current batch
+        assert hw <= 3 * batch_bytes, hw
+
+    def test_generous_budget_runs_ahead(self, monkeypatch):
+        monkeypatch.setenv("BST_EARLY_DISPATCH", "1")
+        monkeypatch.setenv("BST_INFLIGHT_BYTES", str(1 << 30))
+        got = {}
+        import time
+
+        def consume(it, out):
+            time.sleep(0.02)
+            got[it] = np.asarray(out).copy()
+
+        hw = self._run(8, consume)
+        assert sorted(got) == list(range(8))
+        for it, out in got.items():
+            np.testing.assert_allclose(out, np.full((1024,), 2.0 * it))
+        assert hw >= 2 * 1024 * 4, hw                  # >= 2 batches in flight
+
+    def test_starved_budget_still_completes(self, monkeypatch):
+        monkeypatch.setenv("BST_INFLIGHT_BYTES", "1")
+        got = {}
+
+        def consume(it, out):
+            got[it] = np.asarray(out).copy()
+
+        hw = self._run(6, consume, per_dev=2)
+        assert sorted(got) == list(range(6))
+        for it, out in got.items():
+            np.testing.assert_allclose(out, np.full((1024,), 2.0 * it))
+
+    def test_retry_restages_inside_window(self, monkeypatch):
+        # a consume failure while successors are dispatched ahead must
+        # retry cleanly: every item lands exactly once, ledger drains to 0
+        monkeypatch.setenv("BST_EARLY_DISPATCH", "1")
+        monkeypatch.setenv("BST_INFLIGHT_BYTES", str(1 << 30))
+        from bigstitcher_spark_tpu.utils import devicemem
+
+        fails = {"n": 0}
+        got = {}
+        import time
+
+        def consume(it, out):
+            time.sleep(0.01)
+            if it == 2 and fails["n"] == 0:
+                fails["n"] += 1
+                raise RuntimeError("transient write failure")
+            assert it not in got, f"item {it} consumed twice"
+            got[it] = np.asarray(out).copy()
+
+        self._run(8, consume)
+        assert sorted(got) == list(range(8)) and fails["n"] == 1
+        assert devicemem._INFLIGHT.value == 0
