@@ -451,9 +451,11 @@ def _io_snapshot(baseline):
     from bigstitcher_spark_tpu.observe import metrics
 
     delta = metrics.get_registry().snapshot_delta(baseline)
-    return {k: int(v) for k, v in delta.items()
+    return {k: (int(v) if float(v).is_integer() else round(float(v), 3))
+            for k, v in delta.items()
             if k.startswith(("bst_io_", "bst_xfer_", "bst_chunk_cache_",
-                             "bst_tile_cache_", "bst_inflight_"))
+                             "bst_tile_cache_", "bst_inflight_",
+                             "bst_pair_"))
             and isinstance(v, (int, float)) and v}
 
 

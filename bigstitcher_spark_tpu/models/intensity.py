@@ -199,16 +199,25 @@ def match_pair_intensities(
 def match_intensities(
     sd: SpimData, loader: ViewLoader, views: list[ViewId],
     params: IntensityParams | None = None, progress: bool = True,
+    devices: int | None = None,
 ) -> list[CellMatch]:
-    """All overlapping pairs (SparkIntensityMatching.java:146-166)."""
+    """All overlapping pairs (SparkIntensityMatching.java:146-166).
+
+    Pairs spread over every local device via the pair scheduler, weighted
+    by each overlap's renderScale-grid sample count; seeds are attached
+    per pair so placement never changes the fits and multi-device output
+    equals single-device exactly."""
+    from ..parallel.pairsched import PairTask, run_pair_tasks
+
     params = params or IntensityParams()
     views = sorted(views)
     boxes = {
         v: transformed_interval(sd.model(v), Interval.from_shape(sd.view_size(v)))
         for v in views
     }
-    matches: list[CellMatch] = []
-    k = 0
+    step = max(1.0 / params.render_scale, 1.0)
+    pairs: list[tuple[ViewId, ViewId]] = []
+    tasks: list[PairTask] = []
     for i in range(len(views)):
         for j in range(i + 1, len(views)):
             va, vb = views[i], views[j]
@@ -216,12 +225,26 @@ def match_intensities(
                 continue
             if not boxes[va].overlaps(boxes[vb]):
                 continue
-            m = match_pair_intensities(sd, loader, va, vb, params, seed=5 + k)
-            k += 1
-            matches.extend(m)
-            observe.log(f"  {va} <-> {vb}: {len(m)} cell matches",
-                        stage="match-intensities", echo=progress,
-                        matches=len(m))
+            ov = boxes[va].intersect(boxes[vb])
+            # placement ∝ the pair's sample-grid point count
+            n_samples = float(np.prod(
+                [max(1.0, (ov.shape[d] - 1) / step + 1) for d in range(3)]))
+            tasks.append(PairTask(index=len(tasks), cost=n_samples,
+                                  tag=(len(pairs), va, vb)))
+            pairs.append((va, vb))
+
+    def run_one(task):
+        k, va, vb = task.tag
+        return match_pair_intensities(sd, loader, va, vb, params, seed=5 + k)
+
+    outs = run_pair_tasks(tasks, run_one, n_devices=devices,
+                          stage="intensity")
+    matches: list[CellMatch] = []
+    for (va, vb), m in zip(pairs, outs):
+        matches.extend(m)
+        observe.log(f"  {va} <-> {vb}: {len(m)} cell matches",
+                    stage="match-intensities", echo=progress,
+                    matches=len(m))
     return matches
 
 

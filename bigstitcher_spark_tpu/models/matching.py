@@ -321,6 +321,7 @@ def _match_grouped(
     params: MatchingParams,
     store: InterestPointStore,
     progress: bool,
+    devices: int | None = None,
 ) -> list[PairMatchResult]:
     """Grouped matching: pool member views' points, merge near-duplicates,
     match once per group pair, split inliers back per view pair
@@ -354,9 +355,22 @@ def _match_grouped(
         keep = merge_min_distance(view_of, pts, params.merge_distance)
         return view_of[keep], ids[keep], pts[keep]
 
+    from ..parallel.pairsched import PairTask, run_pair_tasks
+
     min_matches = M.MIN_POINTS[params.model]
     results: list[PairMatchResult] = []
+    # prefetch member clouds once (IO, caller's thread; cache read-only
+    # afterwards). Pooling/merging runs inside each worker so one pair's
+    # merged clouds are resident per worker, not all pairs at once.
+    ptasks = []
     for k, (ga, gb) in enumerate(pairs):
+        na = sum(len(world(v)[1]) for v in ga)
+        nb = sum(len(world(v)[1]) for v in gb)
+        ptasks.append(PairTask(index=len(ptasks), cost=_pair_cost(na, nb),
+                               tag=(k, ga, gb)))
+
+    def run_one(task):
+        k, ga, gb = task.tag
         va_of, ids_a, wa = pooled(ga)
         vb_of, ids_b, wb = pooled(gb)
         if params.interest_points_for_overlap_only:
@@ -364,7 +378,7 @@ def _match_grouped(
             # within a group (SparkGeometricDescriptorMatching.java:404-411)
             ov = _group_bbox(sd, ga).intersect(_group_bbox(sd, gb)).expand(2)
             if ov.is_empty():
-                continue
+                return None
             ka = np.all((wa >= np.array(ov.min)) & (wa <= np.array(ov.max)),
                         axis=1) if len(wa) else np.zeros(0, bool)
             kb = np.all((wb >= np.array(ov.min)) & (wb <= np.array(ov.max)),
@@ -373,6 +387,15 @@ def _match_grouped(
             vb_of, ids_b, wb = vb_of[kb], ids_b[kb], wb[kb]
         with profiling.span("matching.group_pair"):
             inl, model, n_cand = match_pair(wa, wb, params, seed=17 + k)
+        return inl, model, n_cand, va_of, ids_a, vb_of, ids_b
+
+    outs = run_pair_tasks(ptasks, run_one, n_devices=devices,
+                          stage="matching")
+
+    for (ga, gb), out in zip(pairs, outs):
+        if out is None:  # empty group-overlap bbox: nothing to match
+            continue
+        inl, model, n_cand, va_of, ids_a, vb_of, ids_b = out
         observe.log(f"  group {ga[0]}x{len(ga)} <-> {gb[0]}x{len(gb)}: "
                     f"{len(inl)} inliers / {n_cand} candidates",
                     stage="matching", echo=progress,
@@ -399,15 +422,29 @@ def _match_grouped(
     return results
 
 
+def _pair_cost(na: int, nb: int) -> float:
+    """Placement weight for one pair's device work given the two cloud
+    sizes: the descriptor ratio test is ~|A|x|B| and the per-cloud kNN
+    ~|A|²+|B|² distance entries."""
+    return float(na * nb + na * na + nb * nb + 1)
+
+
 def match_interest_points(
     sd: SpimData,
     views: list[ViewId],
     params: MatchingParams | None = None,
     store: InterestPointStore | None = None,
     progress: bool = True,
+    devices: int | None = None,
 ) -> list[PairMatchResult]:
     """Run pairwise matching over all planned pairs; results are NOT yet
-    persisted (use ``save_matches``)."""
+    persisted (use ``save_matches``).
+
+    Point clouds load once on the caller's thread (IO); the per-pair
+    device cascades (descriptor kNN + ratio test + RANSAC) then spread
+    over every local device via the pair scheduler, weighted by descriptor
+    count. Seeds are attached per task index, so placement never changes
+    results and multi-device output equals single-device exactly."""
     params = params or MatchingParams()
     store = store or InterestPointStore.for_project(sd)
     if params.grouped:
@@ -416,7 +453,8 @@ def match_interest_points(
                 "grouped matching (--groupTiles/--groupChannels/"
                 "--groupIllums/--splitTimepoints) supports a single label; "
                 "run ungrouped for multi-label / --matchAcrossLabels")
-        return _match_grouped(sd, views, params, store, progress)
+        return _match_grouped(sd, views, params, store, progress,
+                              devices=devices)
     pairs = plan_match_pairs(sd, views, params)
     observe.log(f"matching: {len(pairs)} view pairs, method {params.method}, "
                 f"model {params.model} reg {params.regularization} "
@@ -433,10 +471,23 @@ def match_interest_points(
             cache[key] = (ids, w)
         return cache[key]
 
+    from ..parallel.pairsched import PairTask, run_pair_tasks
+
     label_tasks = params.label_pairs()
-    results = []
     tasks = [(va, vb, la, lb) for va, vb in pairs for la, lb in label_tasks]
+    # prefetch every needed cloud ONCE on the caller's thread (IO); the
+    # cache is read-only from here on, so worker threads share it safely.
+    # Tags carry only keys — per-pair filtered copies are built (and
+    # dropped) inside each worker, not pinned for the whole stage.
+    ptasks = []
     for k, (va, vb, la, lb) in enumerate(tasks):
+        _, wa = world(va, la)
+        _, wb = world(vb, lb)
+        ptasks.append(PairTask(index=k, cost=_pair_cost(len(wa), len(wb)),
+                               tag=(k, va, vb, la, lb)))
+
+    def run_one(task):
+        k, va, vb, la, lb = task.tag
         ids_a, wa = world(va, la)
         ids_b, wb = world(vb, lb)
         if params.interest_points_for_overlap_only:
@@ -444,12 +495,20 @@ def match_interest_points(
             ids_b, wb = _filter_to_overlap(sd, ids_b, wb, vb, va)
         with profiling.span("matching.pair"):
             inl, model, n_cand = match_pair(wa, wb, params, seed=17 + k)
-        res = PairMatchResult(
-            va, vb,
+        return (
+            inl, model, n_cand,
             ids_a[inl[:, 0]] if len(inl) else np.zeros(0, np.uint64),
             ids_b[inl[:, 1]] if len(inl) else np.zeros(0, np.uint64),
-            model, n_cand, label_a=la, label_b=lb,
         )
+
+    outs = run_pair_tasks(ptasks, run_one, n_devices=devices,
+                          stage="matching")
+
+    results = []
+    for (va, vb, la, lb), (inl, model, n_cand, sel_a, sel_b) in zip(
+            tasks, outs):
+        res = PairMatchResult(va, vb, sel_a, sel_b, model, n_cand,
+                              label_a=la, label_b=lb)
         results.append(res)
         observe.log(f"  {va} <-> {vb}: {len(inl)} inliers / {n_cand} "
                     "candidates", stage="matching", echo=progress,

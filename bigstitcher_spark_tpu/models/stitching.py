@@ -65,12 +65,12 @@ class StitchingParams:
     # 2x2 fixture's corner pairs at full resolution)
     min_overlap_frac: float = 0.25
     batch_size: int = 16
-    # ceiling on ONE segment's padded crop-stack bytes: within a segment
-    # every bucket's program is dispatched and ALL peak tables come back
-    # in one pipelined fetch, so per-sync round-trip latency is paid per
-    # segment, not per shape bucket. Two segments are in flight at once
-    # (refine overlaps compute), so size for ~2x this value pinned.
-    inflight_bytes: int = 1 << 30
+    # PER-DEVICE ceiling on dispatched-but-undrained PCM bytes (padded f32
+    # crop stacks x the FFT workspace multiplier below). None derives the
+    # budget from the backend's memory_stats (utils.devicemem;
+    # BST_PAIR_INFLIGHT_BYTES overrides per device) instead of a flat
+    # constant that either starves big HBMs or overcommits small ones.
+    inflight_bytes: int | None = None
 
 
 @dataclass
@@ -303,6 +303,7 @@ def stitch_all_pairs(
     views: list[ViewId],
     params: StitchingParams | None = None,
     progress: bool = True,
+    devices: int | None = None,
 ) -> list[PairwiseStitchingResult]:
     """Compute pairwise shifts for every overlapping tile pair.
 
@@ -322,22 +323,38 @@ def stitch_all_pairs(
         if job is not None:
             jobs.append(job)
 
-    return stitch_jobs(sd, jobs, params)
+    return stitch_jobs(sd, jobs, params, devices=devices)
 
 
-def stitch_jobs(sd, jobs: list[_PairJob], params: StitchingParams
+# resident bytes one PCM dispatch pins beyond its a+b f32 input stacks:
+# windowed copies, two rfftn complex spectra, the normalized cross-power
+# and the irfftn PCM — ~4x the input stacks in practice (ADVICE r5: the
+# old ledger charged only the inputs and undercounted the FFT workspace)
+_FFT_WORKSPACE_MULT = 4.0
+
+
+def stitch_jobs(sd, jobs: list[_PairJob], params: StitchingParams,
+                devices: int | None = None, multihost: bool = False
                 ) -> list[PairwiseStitchingResult]:
     """Run the device PCM + host refinement pipeline over prepared jobs.
 
-    Chunks (shape-bucketed pair batches) are grouped into SEGMENTS whose
-    padded crop stacks together stay under ``params.inflight_bytes``: a
-    segment's programs all dispatch back-to-back (JAX dispatch is async)
-    and their peak tables come back in ONE pipelined ``jax.device_get``,
-    so the per-sync round-trip — which dominates small workloads on a
-    tunneled device — is paid once per segment instead of once per shape
-    bucket. Host refinement of segment k overlaps the device FFTs of
-    segment k+1, so up to TWO segments' input stacks (~2x the ceiling)
-    are pinned at once — bounded by the knob, not the total pair count."""
+    Chunks (shape-bucketed pair batches) become pair-scheduler tasks spread
+    over every local device (parallel.pairsched): placement is weighted by
+    FFT volume, each device bounds its dispatched-but-undrained bytes with
+    its own window (inputs x FFT workspace multiplier against the
+    device-derived budget — ``params.inflight_bytes`` overrides), and each
+    device's drain is pipelined so host refinement of one bucket overlaps
+    the device FFTs of the next. One local device degrades to exactly that
+    pipelined loop on the caller's thread (the pre-sharding path).
+
+    ``multihost=True`` composes with ``parallel.distributed``: chunks
+    split across processes FIRST (strided ``partition_items``), each
+    process's slice over its local devices second — the returned list
+    then holds only THIS process's pairs (collecting the slices into one
+    XML is the caller's concern; default False keeps the reference's
+    driver-side-collect single-process contract)."""
+    from ..parallel.pairsched import PairTask, run_pair_tasks
+
     buckets: dict[tuple, list[_PairJob]] = {}
     for j in jobs:
         shp = _fft_shape(np.maximum(j.crop_a.shape, j.crop_b.shape))
@@ -348,37 +365,40 @@ def stitch_jobs(sd, jobs: list[_PairJob], params: StitchingParams
         for i in range(0, len(bjobs), params.batch_size):
             chunks.append((shp, bjobs[i:i + params.batch_size]))
 
-    segments: list[list[tuple]] = []
-    cur, cur_bytes = [], 0
-    for shp, chunk in chunks:
-        nbytes = 2 * len(chunk) * int(np.prod(shp)) * 4  # a+b f32 stacks
-        if cur and cur_bytes + nbytes > params.inflight_bytes:
-            segments.append(cur)
-            cur, cur_bytes = [], 0
-        cur.append((shp, chunk))
-        cur_bytes += nbytes
-    if cur:
-        segments.append(cur)
+    tasks = []
+    for i, (shp, chunk) in enumerate(chunks):
+        vol = int(np.prod(shp))
+        stack_bytes = 2 * len(chunk) * vol * 4  # a+b stacks, f32 on device
+        tasks.append(PairTask(
+            index=i,
+            cost=float(len(chunk) * vol),       # placement ∝ FFT volume
+            nbytes=int(stack_bytes * _FFT_WORKSPACE_MULT),
+            tag=(shp, chunk),
+        ))
 
-    results: list[PairwiseStitchingResult] = []
-
-    def drain(seg_devs):
-        with profiling.span("stitching.kernel_sync"):
-            peaks_list = jax.device_get([d for _, _, d in seg_devs])
-        for (shp, chunk, _), peaks in zip(seg_devs, peaks_list):
-            results.extend(_refine_bucket(sd, chunk, shp, peaks, params))
-
-    prev = None
-    for seg in segments:
+    def dispatch(task):
+        shp, chunk = task.tag
         with profiling.span("stitching.kernel"):
-            seg_devs = [(shp, chunk, _dispatch_bucket(chunk, shp, params))
-                        for shp, chunk in seg]
-        if prev is not None:
-            drain(prev)
-        prev = seg_devs
-    if prev is not None:
-        drain(prev)
-    return results
+            return _dispatch_bucket(chunk, shp, params)
+
+    def drain(seg_tasks, peaks_devs):
+        # one pipelined fetch for the whole segment: round-trip latency —
+        # which dominates small workloads on a tunneled device — is paid
+        # per memory-bounded segment, not per shape bucket
+        with profiling.span("stitching.kernel_sync"):
+            peaks_list = jax.device_get(list(peaks_devs))
+        out = []
+        for task, peaks in zip(seg_tasks, peaks_list):
+            shp, chunk = task.tag
+            out.append(_refine_bucket(sd, chunk, shp, peaks, params))
+        return out
+
+    per_chunk = run_pair_tasks(tasks, dispatch, drain, n_devices=devices,
+                               stage="stitching",
+                               budget_bytes=params.inflight_bytes,
+                               multihost=multihost)
+    return [r for chunk_results in per_chunk
+            if chunk_results is not None for r in chunk_results]
 
 
 def _as_uint16_lossless(stack: np.ndarray) -> np.ndarray | None:
@@ -451,10 +471,15 @@ def _refine_bucket(sd, jobs: list[_PairJob], shp, peaks,
         # bound concurrent scorers by their SAT footprint: each refine
         # builds 4 float64 summed-area tables (~32 B/crop voxel), so an
         # unbounded 8-thread pool over huge crops would hold gigabytes of
-        # transient tables at once
+        # transient tables at once. The 2e9 host budget is shared across
+        # the drains actually refining concurrently (the pair scheduler's
+        # active workers; 1 on the inline single-device path)
+        from ..parallel.pairsched import concurrent_pair_workers
+
         sat_bytes = 32 * max(int(np.prod(j.crop_a.shape))
                              + int(np.prod(j.crop_b.shape)) for j in jobs)
-        budget = max(1, int(2e9 // max(sat_bytes, 1)))
+        budget = max(1, int(2e9 // max(concurrent_pair_workers(), 1)
+                            // max(sat_bytes, 1)))
         workers = min(8, len(jobs), budget)
         if workers > 1:
             from concurrent.futures import ThreadPoolExecutor

@@ -232,7 +232,21 @@ def match_ratio_test(desc_a, owner_a, desc_b, owner_b, ratio,
     chunk_cost = (rb * dim * 4          # row slice copy
                   + 2 * rb * cb * 4     # distance tile + masked variant
                   + rb * (topk + cb) * 8)  # scan carry + top_k workspace
-    per_seg = max(1, int(dispatch_budget_bytes() // (2 * chunk_cost)))
+    # under the pair scheduler this runs pinned to a worker's device
+    # (thread-local jax.default_device); size the segment window from THAT
+    # device's PER-WORKER budget — N concurrent workers each claiming the
+    # whole process fallback would pin N x the intended bytes, while
+    # dividing by more workers than actually run shrinks the window and
+    # pays avoidable sync round-trips
+    own_dev = getattr(jax.config, "jax_default_device", None)
+    if own_dev is not None:
+        from ..parallel.pairsched import concurrent_pair_workers
+        from ..utils.devicemem import pair_budget_bytes
+
+        budget = pair_budget_bytes(own_dev, concurrent_pair_workers())
+    else:
+        budget = dispatch_budget_bytes()
+    per_seg = max(1, int(budget // (2 * chunk_cost)))
     window = InflightWindow()
     starts = list(range(0, da, rb))
     ratio32 = jnp.float32(ratio)

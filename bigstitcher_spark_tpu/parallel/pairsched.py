@@ -1,0 +1,390 @@
+"""Pair-work mesh scheduler: spread shape-bucketed pair batches over every
+local device.
+
+The block-parallel stages (fusion/detection/downsample/resave) scale via
+``run_sharded_batches`` — a stacked batch axis sharded over a 1-D mesh. The
+PAIR-parallel stages (stitching phase correlation, descriptor matching,
+intensity matching) cannot take that shape: their work items are whole
+per-pair programs (an FFT over one bucket's padded crop stack, a kNN +
+RANSAC cascade over one pair's descriptors, one pair's cell-sample fits)
+with host post-processing between device calls. Before this module they all
+ran on the default device — batched and pipelined, but leaving every other
+chip idle (the round-5 VERDICT's first open item; JAMPI/SparkCL make the
+same move for Spark matmul / heterogeneous accelerator clusters).
+
+Design:
+
+- **Placement** is cost-weighted greedy (LPT): tasks sorted by descending
+  cost (FFT volume for PCM, descriptor count for kNN/RANSAC, sample count
+  for intensity) land on the least-loaded device; ties break by task order
+  so placement is deterministic. Greedy-on-min guarantees
+  ``max_load - min_load <= max task cost``.
+- **Affinity** is per-thread: one worker thread per device runs its queue
+  under ``jax.default_device(dev)`` (thread-local in jax), so every
+  dispatch a task makes — including multi-step host/device cascades like
+  RANSAC — lands on its device with no caller changes.
+- **Windows** are per device: each worker bounds dispatched-but-undrained
+  bytes with its own ``InflightWindow`` whose budget derives from THAT
+  device's ``memory_stats`` (``BST_PAIR_INFLIGHT_BYTES`` overrides,
+  ``utils.devicemem`` fallback divided by the local device count
+  otherwise).
+- **Drains** are device-affine, segmented and pipelined: with a split
+  ``dispatch``/``drain``, a worker groups its dispatches into segments of
+  up to half its byte budget and hands each WHOLE segment to one batched
+  ``drain`` call (one pipelined ``jax.device_get`` per segment — the
+  round-trip economics of the r5 stitching drain, now per device), always
+  dispatching the next segment before draining the previous so the device
+  computes while outputs cross the wire. At most two segments (~the
+  budget) are pinned per device, and devices never wait on each other.
+- **Failures** re-dispatch: a task whose device call dies is retried on
+  the OTHER devices (round-robin, the observed device excluded) so one
+  poisoned chip degrades capacity instead of killing the run.
+
+Instrumented through ``observe.metrics``: per-device dispatch/busy
+counters (``bst_pair_dispatch_total`` / ``bst_pair_busy_ms_total``,
+labeled ``stage``+``device``) and a per-stage utilization gauge
+(``bst_pair_device_util_pct`` = busy time over devices x wall) — the
+MULTICHIP dryrun and the bench ``"io"`` columns read these to prove the
+spread without a tunnel window.
+
+``BST_PAIR_SHARD=0`` opts out (single-device, today's pipelined path);
+one local device degrades to the same thing automatically.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+from ..observe import events, metrics as _metrics, progress as _progress
+from .retry import RetryError
+
+# placement treats zero-cost tasks as infinitesimally heavy so they still
+# spread round-robin instead of piling onto one bin
+_MIN_COST = 1e-9
+
+# failed tasks are re-attempted on this many OTHER devices before the
+# stage gives up (one poisoned device must not kill the run; a task that
+# fails everywhere is genuinely broken)
+_MAX_REDISPATCH = 3
+
+
+def pair_devices(n_devices: int | None = None, devices=None) -> list:
+    """The devices a pair stage may schedule on: local devices, optionally
+    limited to the first ``n_devices`` (the dryrun's single-device control
+    runs), or collapsed to one by the ``BST_PAIR_SHARD=0`` opt-out."""
+    import jax
+
+    devs = list(devices) if devices is not None else list(jax.local_devices())
+    # only explicit falsy spellings opt out — a stray BST_PAIR_SHARD=2 or
+    # =true must not silently collapse every pair stage to one device
+    if os.environ.get("BST_PAIR_SHARD", "1").strip().lower() in (
+            "0", "false", "no", "off"):
+        devs = devs[:1]
+    if n_devices is not None:
+        devs = devs[: max(1, int(n_devices))]
+    return devs
+
+
+@dataclass
+class PairTask:
+    """One schedulable unit of pair work.
+
+    ``index`` is the result slot (callers number tasks 0..N-1; outputs come
+    back in that order regardless of placement). ``cost`` drives placement
+    (any stage-appropriate proxy: FFT volume, descriptor count, sample
+    count). ``nbytes`` is the device-resident estimate charged against the
+    owning device's in-flight window while the task is dispatched but not
+    yet drained (0 for tasks that run dispatch-to-result in one step)."""
+
+    index: int
+    cost: float = 1.0
+    nbytes: int = 0
+    tag: Any = None
+
+
+def assign_tasks(tasks: Sequence[PairTask], n_bins: int) -> list[list[PairTask]]:
+    """Cost-weighted greedy (LPT) placement: heaviest task first onto the
+    least-loaded bin; deterministic (ties by bin index, stable task order).
+    Guarantees ``max_load - min_load <= max task cost``."""
+    bins: list[list[PairTask]] = [[] for _ in range(max(n_bins, 1))]
+    loads = [0.0] * len(bins)
+    for t in sorted(tasks, key=lambda t: (-max(t.cost, 0.0), t.index)):
+        b = min(range(len(bins)), key=lambda i: (loads[i], i))
+        bins[b].append(t)
+        loads[b] += max(t.cost, _MIN_COST)
+    return bins
+
+
+_TLS = threading.local()
+
+
+def concurrent_pair_workers() -> int:
+    """Number of device workers in THIS thread's scheduler run (1 outside
+    a worker thread) — shared host-side resources sized per drain (e.g.
+    the stitching refinement thread budget) divide by actual concurrency,
+    not the host's device count."""
+    return getattr(_TLS, "n_workers", 1)
+
+
+class _StageMeters:
+    """Per-(stage, device) dispatch/busy counters + the stage utilization
+    gauge, shared by every worker of one run."""
+
+    def __init__(self, stage: str, n_dev: int):
+        self.stage = stage
+        self.dispatch = [
+            _metrics.counter("bst_pair_dispatch_total", stage=stage,
+                             device=str(i)) for i in range(n_dev)
+        ]
+        self.busy_ms = [
+            _metrics.counter("bst_pair_busy_ms_total", stage=stage,
+                             device=str(i)) for i in range(n_dev)
+        ]
+        self.redispatch = _metrics.counter("bst_pair_redispatch_total",
+                                           stage=stage)
+        self.util = _metrics.gauge("bst_pair_device_util_pct", stage=stage)
+        self._busy_s = [0.0] * n_dev
+        self._lock = threading.Lock()
+
+    def add_busy(self, di: int, seconds: float) -> None:
+        # float increment: many sub-ms tasks must not truncate to 0
+        self.busy_ms[di].inc(seconds * 1000.0)
+        with self._lock:
+            self._busy_s[di] += seconds
+
+    def finish(self, wall_s: float) -> None:
+        n = len(self._busy_s)
+        if n and wall_s > 0:
+            self.util.set(round(100.0 * sum(self._busy_s) / (n * wall_s), 1))
+
+
+def _run_queue(queue, di, dispatch, drain, window, results, failures,
+               meters: _StageMeters, hb: _progress.Heartbeat):
+    """One device's pipelined loop. Without ``drain``, tasks run
+    dispatch-to-result in order. With ``drain``, dispatches accumulate
+    into SEGMENTS of up to half the device's byte budget; each segment
+    drains in ONE batched call, and the next segment always dispatches
+    before the previous one drains — so at most two segments (~the
+    budget) are pinned while the device computes ahead of the fetch.
+    Failures are collected, never raised (the caller re-dispatches them
+    on other devices)."""
+    if drain is None:
+        for t in queue:
+            try:
+                t0 = time.perf_counter()
+                results[t.index] = (True, dispatch(t))
+                meters.add_busy(di, time.perf_counter() - t0)
+                meters.dispatch[di].inc()
+                hb.tick()
+            except Exception as e:  # noqa: BLE001 - re-dispatched by caller
+                failures.append((t, di, e))
+        return
+
+    half = max(1, window.budget // 2)
+    seg: list[tuple[PairTask, Any]] = []
+    seg_bytes = 0
+    prev: list[tuple[PairTask, Any]] | None = None
+
+    def flush(group):
+        tasks = [t for t, _ in group]
+        try:
+            t0 = time.perf_counter()
+            outs = drain(tasks, [h for _, h in group])
+            meters.add_busy(di, time.perf_counter() - t0)
+            for t, r in zip(tasks, outs):
+                results[t.index] = (True, r)
+                hb.tick()
+        except Exception:  # noqa: BLE001 - isolate, then re-dispatch
+            # a batched-drain error usually belongs to ONE task's host
+            # post-processing: drain each task singly so its healthy
+            # neighbours keep their (already computed) results and only
+            # the offender re-dispatches; a dead device fails every
+            # single drain too and the whole group re-dispatches as
+            # before
+            for t, h in group:
+                try:
+                    results[t.index] = (True, drain([t], [h])[0])
+                    hb.tick()
+                except Exception as e:  # noqa: BLE001
+                    failures.append((t, di, e))
+        finally:
+            for t in tasks:
+                window.release(t.nbytes)
+
+    for t in queue:
+        if seg and seg_bytes + t.nbytes > half:
+            if prev is not None:
+                flush(prev)
+            prev, seg, seg_bytes = seg, [], 0
+        try:
+            t0 = time.perf_counter()
+            out = dispatch(t)
+            meters.add_busy(di, time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 - re-dispatched by caller
+            failures.append((t, di, e))
+            continue
+        meters.dispatch[di].inc()
+        window.charge(t.nbytes)
+        seg.append((t, out))
+        seg_bytes += t.nbytes
+    if prev is not None:
+        flush(prev)
+    if seg:
+        flush(seg)
+
+
+def run_pair_tasks(
+    tasks: Sequence[PairTask],
+    dispatch: Callable[[PairTask], Any],
+    drain: Callable[[PairTask, Any], Any] | None = None,
+    *,
+    devices=None,
+    n_devices: int | None = None,
+    stage: str = "pairs",
+    budget_bytes: int | None = None,
+    multihost: bool = False,
+) -> list:
+    """Run pair tasks across the local device mesh; results in task-index
+    order.
+
+    ``dispatch(task)`` runs under the task's assigned device
+    (``jax.default_device``); with ``drain`` it returns un-fetched device
+    handles and ``drain(tasks, handles)`` later fetches + post-processes a
+    whole SEGMENT of them in one batched call (the pipelined segmented
+    mode the stitching PCM uses — one ``jax.device_get`` round-trip per
+    memory-bounded segment, the device computing the next segment while
+    this one's peak tables cross the wire); without ``drain`` it returns
+    the final result directly (the mode for host/device cascades like
+    descriptor matching and intensity fits).
+
+    One local device (or ``BST_PAIR_SHARD=0``) runs the same pipelined loop
+    inline on the caller's thread — no placement, no extra threads, the
+    pre-sharding behavior. Tasks whose device call fails are re-dispatched
+    on the other devices (round-robin) before the stage raises
+    ``RetryError``.
+
+    ``multihost=True`` composes with ``parallel.distributed``: pairs split
+    across PROCESSES first (the deterministic strided slice of
+    ``partition_items``) and this process's local devices second. The
+    returned list is still full-length in task order, with ``None`` at
+    every index another process owns — collecting/merging the per-process
+    slices (these stages are driver-side collects in the reference) stays
+    the caller's concern."""
+    tasks = list(tasks)
+    remote_idx: set[int] = set()
+    if multihost:
+        from .distributed import partition_items
+
+        local = partition_items(tasks)
+        local_idx = {t.index for t in local}
+        remote_idx = {t.index for t in tasks if t.index not in local_idx}
+        tasks = local
+    if not tasks:
+        return [None] * (max(remote_idx) + 1) if remote_idx else []
+    devs = pair_devices(n_devices, devices)
+    n_dev = len(devs)
+    n_slots = max(max(t.index for t in tasks) + 1,
+                  (max(remote_idx) + 1) if remote_idx else 0)
+    results: list = [None] * n_slots
+    failures: list[tuple[PairTask, int, Exception]] = []
+    meters = _StageMeters(stage, n_dev)
+    # live done/total heartbeat (PR-1 progress events): long pair stages
+    # must be distinguishable from hung ones while workers run
+    hb = _progress.Heartbeat(f"pairs-{stage}", len(tasks))
+    t_start = time.perf_counter()
+
+    if n_dev <= 1:
+        import jax
+
+        from ..utils.devicemem import InflightWindow, pair_budget_bytes
+
+        budget = (budget_bytes if budget_bytes is not None
+                  else pair_budget_bytes(devs[0] if devs else None, 1))
+        window = InflightWindow(budget)
+        # pin to the RESOLVED device: an explicit devices=[...] selection
+        # must route work there, not to the process default
+        with jax.default_device(devs[0] if devs else None):
+            _run_queue(tasks, 0, dispatch, drain, window, results, failures,
+                       meters, hb)
+    else:
+        import jax
+
+        queues = assign_tasks(tasks, n_dev)
+        n_active = sum(1 for q in queues if q)
+
+        def worker(di: int):
+            from ..utils.devicemem import InflightWindow, pair_budget_bytes
+
+            _TLS.n_workers = n_active
+            budget = (budget_bytes if budget_bytes is not None
+                      else pair_budget_bytes(devs[di], n_active))
+            window = InflightWindow(budget)
+            with jax.default_device(devs[di]):
+                _run_queue(queues[di], di, dispatch, drain, window, results,
+                           failures, meters, hb)
+
+        threads = [
+            threading.Thread(target=worker, args=(di,), daemon=True,
+                             name=f"bst-pair-{stage}-{di}")
+            for di in range(n_dev) if queues[di]
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+
+    # re-dispatch failed tasks on devices OTHER than the one observed
+    # failing (single-device runs retry in place — there is nowhere else).
+    # This runs serially on the caller's thread after the workers join: a
+    # device that dies early turns its queue's tail into sequential work,
+    # a deliberate simplicity/size tradeoff — device death is rare and
+    # capacity (not latency) is what must survive it.
+    if failures:
+        import jax
+
+        for t, bad_di, err in list(failures):
+            last = err
+            retried = False
+            for k in range(1, max(n_dev, 2)):
+                di = (bad_di + k) % n_dev
+                if k > _MAX_REDISPATCH:
+                    break
+                meters.redispatch.inc()
+                events.emit("pair.redispatch", stage=stage, task=t.index,
+                            from_device=bad_di, to_device=di,
+                            error=repr(err)[:200])
+                try:
+                    with jax.default_device(devs[di]):
+                        out = dispatch(t)
+                        meters.dispatch[di].inc()
+                        results[t.index] = (
+                            True,
+                            drain([t], [out])[0] if drain is not None
+                            else out)
+                    hb.tick()
+                    retried = True
+                    break
+                except Exception as e:  # noqa: BLE001 - try next device
+                    last = e
+            if not retried:
+                meters.finish(time.perf_counter() - t_start)
+                hb.finish(failed=1)
+                raise RetryError(
+                    f"pair task {t.index} ({stage}) failed on device "
+                    f"{bad_di} and every re-dispatch target: {last!r}"
+                ) from last
+
+    meters.finish(time.perf_counter() - t_start)
+    hb.finish()
+    missing = [i for i, r in enumerate(results)
+               if r is None and i not in remote_idx]
+    if missing:
+        raise RetryError(
+            f"{stage}: {len(missing)} pair task(s) produced no result "
+            f"(indices {missing[:8]}...)")
+    return [None if r is None else r[1] for r in results]

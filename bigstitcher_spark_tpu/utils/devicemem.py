@@ -34,29 +34,61 @@ _HIGHWATER = _metrics.gauge("bst_inflight_bytes_highwater")
 _LOCK = threading.Lock()
 
 
-def dispatch_budget_bytes() -> int:
-    """Byte budget for dispatched-but-not-drained device work.
-
-    ``BST_INFLIGHT_BYTES`` wins when set; otherwise the first local
-    device's ``memory_stats`` (free = limit - in_use) scaled by a safety
-    fraction; otherwise ``DEFAULT_BUDGET``."""
+def _derived_budget(device=None) -> tuple[int, str]:
+    """(budget bytes, source) with source ``"env"`` (the process-wide
+    ``BST_INFLIGHT_BYTES``), ``"stats"`` (the device's own
+    ``memory_stats``, genuinely per device) or ``"fallback"`` (the
+    backend reported nothing)."""
     env = os.environ.get("BST_INFLIGHT_BYTES")
     if env:
         try:
-            return max(0, int(float(env)))
+            return max(0, int(float(env))), "env"
         except ValueError:
             pass
     try:
         import jax
 
-        stats = jax.local_devices()[0].memory_stats() or {}
+        if device is None:
+            device = jax.local_devices()[0]
+        stats = device.memory_stats() or {}
         limit = int(stats.get("bytes_limit", 0))
         if limit > 0:
             free = limit - int(stats.get("bytes_in_use", 0))
-            return max(256 << 20, int(_FREE_FRACTION * free))
+            return max(256 << 20, int(_FREE_FRACTION * free)), "stats"
     except Exception:
         pass
-    return DEFAULT_BUDGET
+    return DEFAULT_BUDGET, "fallback"
+
+
+def dispatch_budget_bytes(device=None) -> int:
+    """Byte budget for dispatched-but-not-drained device work.
+
+    ``BST_INFLIGHT_BYTES`` wins when set; otherwise ``device``'s (default:
+    the first local device's) ``memory_stats`` (free = limit - in_use)
+    scaled by a safety fraction; otherwise ``DEFAULT_BUDGET``. Per-device
+    callers (the pair scheduler's one-window-per-device workers) pass
+    their own device so each window sizes to its own HBM."""
+    return _derived_budget(device)[0]
+
+
+def pair_budget_bytes(device=None, n_local: int = 1) -> int:
+    """Per-device in-flight budget for one of ``n_local`` concurrent pair
+    scheduler workers: ``BST_PAIR_INFLIGHT_BYTES`` wins verbatim (it is
+    defined per device); a ``memory_stats``-derived budget is genuinely
+    per device and used as is; the process-wide knobs (the
+    ``BST_INFLIGHT_BYTES`` env, the no-stats fallback) are SPLIT across
+    the workers — N workers must not each claim the whole process
+    budget."""
+    env = os.environ.get("BST_PAIR_INFLIGHT_BYTES")
+    if env:
+        try:
+            return max(0, int(float(env)))
+        except ValueError:
+            pass
+    budget, source = _derived_budget(device)
+    if source != "stats":
+        budget = max(1, budget // max(n_local, 1))
+    return budget
 
 
 class InflightWindow:

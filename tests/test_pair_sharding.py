@@ -1,0 +1,221 @@
+"""Multi-device (virtual 8-CPU mesh) sharding of the PAIR-parallel stages:
+stitching phase correlation, descriptor matching and intensity matching must
+produce EXACTLY the output of the single-device path when their pair work
+spreads over the mesh (parallel/pairsched.py — the round-5 VERDICT's first
+open item: these stages ran batched + pipelined but on one device).
+
+Exactness is by construction: seeds attach to the task index, placement
+never enters the math, and one host's devices run identical XLA programs.
+The 3x3 tile grid yields ~20 overlapping pairs — uneven shape buckets
+(x-adjacent / y-adjacent / diagonal crops) and more tasks than devices, so
+the greedy placement must land work on all 8; the tail tests run with fewer
+pairs than devices."""
+
+import numpy as np
+import pytest
+
+from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+from bigstitcher_spark_tpu.io.spimdata import SpimData
+
+
+def _dispatch_devices(delta, stage):
+    """Device labels of ``bst_pair_dispatch_total`` series that moved."""
+    return {
+        k for k, v in delta.items()
+        if k.startswith("bst_pair_dispatch_total")
+        and f'stage="{stage}"' in k and v > 0
+    }
+
+
+@pytest.fixture(scope="module")
+def grid_project(tmp_path_factory):
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    # smooth_field gives every overlap region intensity dynamic range (the
+    # intensity matcher needs non-constant samples to fit real lines)
+    return make_synthetic_project(
+        str(tmp_path_factory.mktemp("pairshard") / "proj"),
+        n_tiles=(3, 3, 1), tile_size=(32, 32, 16), overlap=12,
+        jitter=2.0, seed=23, block_size=(16, 16, 16),
+        n_beads_per_tile=12, smooth_field=25.0,
+    )
+
+
+@pytest.fixture(scope="module")
+def grid_sd(grid_project):
+    sd = SpimData.load(grid_project.xml_path)
+    return sd, ViewLoader(sd), sd.view_ids()
+
+
+@pytest.fixture(scope="module")
+def point_store(grid_sd, tmp_path_factory):
+    """Synthetic interest points: one world-space bead cloud projected into
+    every view's pixel space — matching then has true correspondences in
+    every overlap without running detection."""
+    from bigstitcher_spark_tpu.io.interestpoints import InterestPointStore
+    from bigstitcher_spark_tpu.utils.geometry import invert_affine
+    from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+    sd, _, views = grid_sd
+    bbox = maximal_bounding_box(sd, views)
+    rng = np.random.default_rng(7)
+    # modest cloud: enough for candidates in every overlap while keeping
+    # the per-device RANSAC pad-size spectrum (and compile count) small
+    world = rng.uniform(np.array(bbox.min, np.float64),
+                        np.array(bbox.max, np.float64), (250, 3))
+    store = InterestPointStore(
+        str(tmp_path_factory.mktemp("pairshard_ips") / "ips.n5"))
+    for v in views:
+        inv = invert_affine(sd.model(v))
+        px = world @ inv[:, :3].T + inv[:, 3]
+        size = np.array(sd.view_size(v), np.float64)
+        inside = np.all((px >= 1) & (px <= size - 2), axis=1)
+        store.save_points(v, "beads", px[inside])
+    return store
+
+
+def _snapshot():
+    from bigstitcher_spark_tpu.observe import metrics
+
+    return metrics.get_registry().snapshot()
+
+
+def _delta(base):
+    from bigstitcher_spark_tpu.observe import metrics
+
+    return metrics.get_registry().snapshot_delta(base)
+
+
+def test_stitching_sharded_equals_single_all_devices(grid_sd):
+    from bigstitcher_spark_tpu.models.stitching import (
+        StitchingParams, stitch_all_pairs,
+    )
+
+    sd, loader, views = grid_sd
+    # batch_size=1: one scheduler task per pair; uneven buckets arise from
+    # the x/y/diagonal overlap shapes
+    params = StitchingParams(min_overlap_px=8, batch_size=1)
+    base = _snapshot()
+    multi = stitch_all_pairs(sd, loader, views, params, progress=False,
+                             devices=8)
+    assert len(_dispatch_devices(_delta(base), "stitching")) == 8
+    single = stitch_all_pairs(sd, loader, views, params, progress=False,
+                              devices=1)
+    assert len(multi) == len(single) >= 8
+    for a, b in zip(multi, single):
+        assert a.pair_key == b.pair_key
+        np.testing.assert_array_equal(a.transform, b.transform)
+        assert a.correlation == b.correlation
+
+
+def test_matching_sharded_equals_single_all_devices(grid_sd, point_store):
+    from bigstitcher_spark_tpu.models.matching import (
+        MatchingParams, match_interest_points,
+    )
+
+    sd, _, views = grid_sd
+    params = MatchingParams(model="TRANSLATION", regularization="NONE",
+                            ransac_min_inliers=4, ransac_iterations=250)
+    base = _snapshot()
+    multi = match_interest_points(sd, views, params, point_store,
+                                  progress=False, devices=8)
+    assert len(_dispatch_devices(_delta(base), "matching")) == 8
+    single = match_interest_points(sd, views, params, point_store,
+                                   progress=False, devices=1)
+    assert len(multi) == len(single) >= 8
+    assert sum(len(r.ids_a) for r in multi) > 0, "no correspondences found"
+    for a, b in zip(multi, single):
+        assert (a.view_a, a.view_b) == (b.view_a, b.view_b)
+        np.testing.assert_array_equal(a.ids_a, b.ids_a)
+        np.testing.assert_array_equal(a.ids_b, b.ids_b)
+        assert a.n_candidates == b.n_candidates
+        if a.model is None:
+            assert b.model is None
+        else:
+            np.testing.assert_array_equal(a.model, b.model)
+
+
+def test_intensity_sharded_equals_single_all_devices(grid_sd):
+    from bigstitcher_spark_tpu.models.intensity import (
+        IntensityParams, match_intensities,
+    )
+
+    sd, loader, views = grid_sd
+    params = IntensityParams(coefficients=(2, 2, 2), render_scale=0.5,
+                             min_num_candidates=20, min_samples_per_cell=5,
+                             min_num_inliers=5, ransac_iterations=300,
+                             max_samples_per_cell=256)
+    base = _snapshot()
+    multi = match_intensities(sd, loader, views, params, progress=False,
+                              devices=8)
+    assert len(_dispatch_devices(_delta(base), "intensity")) == 8
+    single = match_intensities(sd, loader, views, params, progress=False,
+                               devices=1)
+    assert len(multi) == len(single) > 0
+    for a, b in zip(multi, single):
+        assert (a.view_a, a.view_b, a.cell_a, a.cell_b) == \
+            (b.view_a, b.view_b, b.cell_a, b.cell_b)
+        assert a.stats == b.stats
+        assert a.fit == b.fit
+
+
+def test_tail_fewer_pairs_than_devices(grid_sd):
+    """Tail workloads smaller than the device count: a 3-view strip has 2-3
+    overlapping pairs on 8 devices — placement must leave devices idle (not
+    crash or duplicate) and outputs must still equal the single-device
+    path."""
+    from bigstitcher_spark_tpu.models.stitching import (
+        StitchingParams, stitch_all_pairs,
+    )
+
+    sd, loader, views = grid_sd
+    strip = views[:3]
+    params = StitchingParams(min_overlap_px=8, batch_size=1)
+    multi = stitch_all_pairs(sd, loader, strip, params, progress=False,
+                             devices=8)
+    single = stitch_all_pairs(sd, loader, strip, params, progress=False,
+                              devices=1)
+    assert 1 <= len(multi) < 8
+    assert len(multi) == len(single)
+    for a, b in zip(multi, single):
+        assert a.pair_key == b.pair_key
+        np.testing.assert_array_equal(a.transform, b.transform)
+        assert a.correlation == b.correlation
+
+
+def test_retry_redispatches_poisoned_stitching_dispatch(grid_sd,
+                                                        monkeypatch):
+    """A poisoned device call inside the stitching dispatch (first call on
+    device 0 dies) must re-dispatch that bucket onto another device and
+    still deliver every pair's result exactly once."""
+    import jax
+
+    from bigstitcher_spark_tpu.models import stitching as S
+    from bigstitcher_spark_tpu.models.stitching import (
+        StitchingParams, stitch_all_pairs,
+    )
+
+    sd, loader, views = grid_sd
+    params = StitchingParams(min_overlap_px=8, batch_size=1)
+    poisoned = jax.local_devices()[0]
+    real = S._dispatch_bucket
+    fails = {"n": 0}
+
+    def flaky(jobs, shp, p):
+        if jax.config.jax_default_device == poisoned and fails["n"] == 0:
+            fails["n"] += 1
+            raise RuntimeError("poisoned device call")
+        return real(jobs, shp, p)
+
+    monkeypatch.setattr(S, "_dispatch_bucket", flaky)
+    multi = stitch_all_pairs(sd, loader, views, params, progress=False,
+                             devices=8)
+    monkeypatch.setattr(S, "_dispatch_bucket", real)
+    single = stitch_all_pairs(sd, loader, views, params, progress=False,
+                              devices=1)
+    assert fails["n"] == 1, "the poisoned dispatch was never exercised"
+    assert len(multi) == len(single)
+    for a, b in zip(multi, single):
+        assert a.pair_key == b.pair_key
+        np.testing.assert_array_equal(a.transform, b.transform)
+        assert a.correlation == b.correlation
