@@ -33,8 +33,29 @@ import subprocess
 import sys
 import time
 
-FIXTURE = os.environ.get("BST_BENCH_DIR", "/tmp/bst_bench")
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_config_module():
+    """The knob registry WITHOUT the package __init__ (which imports jax):
+    the bench parent is a jax-free watchdog — it probes the accelerator in
+    a timeout-guarded subprocess precisely so a dead TPU tunnel can never
+    hang it, and a module-level `from bigstitcher_spark_tpu import config`
+    would drag the jax import (and TPU plugin discovery) into it."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_bst_bench_config",
+        os.path.join(REPO, "bigstitcher_spark_tpu", "config.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod   # dataclasses resolves cls.__module__
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_cfg = _load_config_module()
+
+FIXTURE = _cfg.get_str("BST_BENCH_DIR")
 BASELINE_FILE = os.path.join(REPO, "BASELINE_MEASURED.json")
 FIXTURE_SPEC = {
     "n_tiles": (2, 2, 1), "tile_size": (256, 256, 128), "overlap": 32,
@@ -44,20 +65,20 @@ FIXTURE_SPEC = {
 # optional fixture scaling for throughput-vs-volume experiments (PERF.md):
 # BST_BENCH_TILE=384 runs the primary config with (384,384,192) tiles;
 # the baseline cache keys on the full spec, so scales never cross-pollute
-if os.environ.get("BST_BENCH_TILE"):
-    _t = int(os.environ["BST_BENCH_TILE"])
+_t = _cfg.get_int("BST_BENCH_TILE")
+if _t:
     FIXTURE_SPEC["tile_size"] = (_t, _t, max(64, _t // 2))
-CHILD_TIMEOUT_S = int(os.environ.get("BST_BENCH_CHILD_TIMEOUT", 1500))
+CHILD_TIMEOUT_S = _cfg.get_int("BST_BENCH_CHILD_TIMEOUT")
 TPU_ATTEMPTS = 2
 # same-process baseline memo (one measurement per bench child)
 _RUN_BASELINES: dict = {}
 # a device call that exceeds this is a tunnel stall, not a slow run: the
 # timed fusion runs take seconds and every extra is <60 s warm, so 300 s
 # means the accelerator went away mid-attempt
-DEVICE_TIMEOUT_S = int(os.environ.get("BST_BENCH_DEVICE_TIMEOUT", 300))
+DEVICE_TIMEOUT_S = _cfg.get_int("BST_BENCH_DEVICE_TIMEOUT")
 # best-of-N: wall-clock noise on a shared host (and tunnel weather on TPU)
 # swings single runs ~30%; five runs stabilize the headline artifact
-FUSION_RUNS = int(os.environ.get("BST_BENCH_RUNS", 5))
+FUSION_RUNS = _cfg.get_int("BST_BENCH_RUNS")
 
 
 def build_fixture():
@@ -121,7 +142,7 @@ def _baseline_cache_load():
 # The cache still records provenance + the previous measurement for
 # comparison; vs_baseline always uses the same-run number.
 def _fresh_baselines() -> bool:
-    return os.environ.get("BST_BENCH_FRESH_BASELINE", "1") == "1"
+    return _cfg.get_bool("BST_BENCH_FRESH_BASELINE")
 
 
 def _baseline_cache_store(cache):
@@ -1253,7 +1274,7 @@ def _checkpoint(result):
     """Write the current (possibly partial) result JSON atomically so the
     parent can salvage the primary metric if this child is killed by the
     timeout (tunnel-weather resilience)."""
-    path = os.environ.get("BST_BENCH_PARTIAL")
+    path = _cfg.get_str("BST_BENCH_PARTIAL")
     if not path:
         return
     tmp = path + ".tmp"
@@ -1403,12 +1424,12 @@ EXTRA_MEASURES = (
 
 def child_main():
     _log("child start")
-    if os.environ.get("BST_TELEMETRY_DIR"):
+    if _cfg.get_str("BST_TELEMETRY_DIR"):
         from bigstitcher_spark_tpu import observe
 
         # same registry/event/manifest path as `bst ... --telemetry-dir`;
         # profiling stays under the bench's own enable/reset control
-        observe.configure(os.environ["BST_TELEMETRY_DIR"], profile=False)
+        observe.configure(_cfg.get_str("BST_TELEMETRY_DIR"), profile=False)
     xml = build_fixture()
     _log("fixture ready")
     out = os.path.join(FIXTURE, "fused.ome.zarr")
@@ -1583,11 +1604,11 @@ def _probe_tpu(timeout_s=300):
 
 
 def main():
-    if os.environ.get("BST_BENCH_CHILD"):
+    if _cfg.get_bool("BST_BENCH_CHILD"):
         child_main()
         return 0
     attempts = []
-    tpu_only = bool(os.environ.get("BST_BENCH_TPU_ONLY"))
+    tpu_only = _cfg.get_bool("BST_BENCH_TPU_ONLY")
     if _probe_tpu():
         for i in range(TPU_ATTEMPTS):
             attempts.append(({}, f"tpu attempt {i + 1}/{TPU_ATTEMPTS}"))

@@ -1,0 +1,32 @@
+"""Self-hosted AST-based invariant analyzer (``bst lint``).
+
+Machine-checks the conventions the package's correctness rests on —
+no hidden host syncs in device hot paths, lock discipline around shared
+mutable state, all ``BST_*`` knobs read through the config registry,
+every metric name declared once — as a tier-1 test and a CLI tool.
+Stdlib ``ast`` only; see :mod:`.checks` for the check catalogue and
+:mod:`.linter` for suppressions and the baseline protocol.
+"""
+
+from .checks import ALL_CHECKS, Finding
+from .linter import (
+    baseline_counts,
+    default_baseline_path,
+    default_root,
+    load_baseline,
+    new_findings,
+    run_lint,
+    save_baseline,
+)
+
+__all__ = [
+    "ALL_CHECKS",
+    "Finding",
+    "baseline_counts",
+    "default_baseline_path",
+    "default_root",
+    "load_baseline",
+    "new_findings",
+    "run_lint",
+    "save_baseline",
+]

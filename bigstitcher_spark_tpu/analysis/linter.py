@@ -1,0 +1,157 @@
+"""``bst lint`` driver: file discovery, suppression comments, baseline.
+
+Runs the invariant checks in :mod:`.checks` over a package tree and
+reconciles the findings against a committed baseline, so NEW violations
+fail tier-1 (tests/test_lint.py, scripts/lint.sh) while any legacy ones
+are tracked instead of silenced.
+
+Suppressions
+------------
+``# bst-lint: off`` or ``# bst-lint: off=check-a,check-b`` on the
+offending line (or the line directly above it, for statements that do
+not fit a trailing comment) suppresses the named checks — the reasoning
+belongs in the same comment. Suppressions are per-line, never per-file:
+a module cannot opt out wholesale.
+
+Baseline
+--------
+``analysis/baseline.json`` maps finding keys (``check|path|source-line``
+— line NUMBERS are deliberately absent, so unrelated edits above a
+legacy finding do not churn the file) to occurrence counts. A finding is
+NEW when its key is absent or its count exceeds the baselined count.
+The shipped baseline is EMPTY: the codebase lints clean, and the
+machinery exists so a future genuinely-unfixable finding can be tracked
+without weakening the gate for everything else.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from pathlib import Path
+
+from .checks import ALL_CHECKS, FileCtx, Finding
+
+_SUPPRESS_RE = re.compile(r"#\s*bst-lint:\s*off(?:=([\w,-]+))?")
+
+# keep full-line suppression state out of these; compiled artifacts etc.
+_SKIP_DIRS = {"__pycache__"}
+
+
+def parse_suppressions(source: str) -> dict[int, frozenset[str] | None]:
+    """line -> suppressed check names (None = all checks)."""
+    out: dict[int, frozenset[str] | None] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            checks = (frozenset(c.strip() for c in m.group(1).split(","))
+                      if m.group(1) else None)
+            out[tok.start[0]] = checks
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _suppressed(finding: Finding,
+                table: dict[int, frozenset[str] | None]) -> bool:
+    for line in (finding.line, finding.line - 1):
+        checks = table.get(line, False)
+        if checks is False:
+            continue
+        if checks is None or finding.check in checks:
+            return True
+    return False
+
+
+def collect_files(root: Path) -> list[tuple[Path, str]]:
+    files = []
+    for p in sorted(root.rglob("*.py")):
+        if any(part in _SKIP_DIRS for part in p.parts):
+            continue
+        files.append((p, p.relative_to(root).as_posix()))
+    return files
+
+
+def run_lint(root: Path | str,
+             checks: dict | None = None) -> list[Finding]:
+    """All unsuppressed findings for the package tree at ``root``."""
+    root = Path(root)
+    ctxs: list[FileCtx] = []
+    suppressions: dict[str, dict] = {}
+    findings: list[Finding] = []
+    for path, rel in collect_files(root):
+        source = path.read_text(encoding="utf-8")
+        try:
+            tree = ast.parse(source, filename=str(path))
+        except SyntaxError as e:
+            findings.append(Finding("parse", rel, e.lineno or 1,
+                                    f"syntax error: {e.msg}", ""))
+            continue
+        ctxs.append(FileCtx(rel, tree, source.splitlines()))
+        suppressions[rel] = parse_suppressions(source)
+    for name, fn in (checks or ALL_CHECKS).items():
+        findings.extend(fn(ctxs))
+    findings = [f for f in findings
+                if not _suppressed(f, suppressions.get(f.path, {}))]
+    findings.sort(key=lambda f: (f.path, f.line, f.check, f.message))
+    return findings
+
+
+# -- baseline --------------------------------------------------------------
+
+def baseline_counts(findings: list[Finding]) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    return counts
+
+
+def load_baseline(path: Path | str) -> dict[str, int]:
+    path = Path(path)
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def save_baseline(path: Path | str, findings: list[Finding]) -> None:
+    payload = {
+        "comment": "bst lint baseline: legacy findings tracked, not "
+                   "silenced; new findings fail. Regenerate with "
+                   "`bst lint --update-baseline`.",
+        "findings": dict(sorted(baseline_counts(findings).items())),
+    }
+    Path(path).write_text(json.dumps(payload, indent=1) + "\n",
+                          encoding="utf-8")
+
+
+def new_findings(findings: list[Finding],
+                 baseline: dict[str, int]) -> list[Finding]:
+    """Findings beyond the baselined count for their key (a key seen N
+    times in the baseline admits N occurrences, any more are new)."""
+    remaining = dict(baseline)
+    out = []
+    for f in findings:
+        if remaining.get(f.key, 0) > 0:
+            remaining[f.key] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+def default_root() -> Path:
+    """The installed package tree (what ``bst lint`` scans by default)."""
+    return Path(__file__).resolve().parent.parent
+
+
+def default_baseline_path(root: Path | str | None = None) -> Path:
+    root = Path(root) if root is not None else default_root()
+    return root / "analysis" / "baseline.json"

@@ -1,0 +1,109 @@
+"""CLI for the static analyzer (``bst lint``) and the runtime-config
+registry (``bst config``).
+
+``bst lint`` is the interactive face of the tier-1 gate
+(tests/test_lint.py, scripts/lint.sh): same checks, same baseline, so a
+clean ``bst lint`` means the tier-1 lint test passes. ``bst config``
+renders the full resolved ``BST_*`` surface — the reference's
+spark-defaults/--conf visibility, which previously required reading 22
+scattered env accesses."""
+
+from __future__ import annotations
+
+import json as _json
+import sys
+from pathlib import Path
+
+import click
+
+
+@click.command()
+@click.option("--root", type=click.Path(exists=True, file_okay=False),
+              default=None,
+              help="package tree to scan (default: the installed "
+                   "bigstitcher_spark_tpu package)")
+@click.option("--baseline", "baseline_path", type=click.Path(), default=None,
+              help="baseline JSON (default: <root>/analysis/baseline.json)")
+@click.option("--fail-on-new/--no-fail-on-new", default=True,
+              show_default=True,
+              help="exit 1 when any non-baselined finding exists")
+@click.option("--all", "show_all", is_flag=True,
+              help="also print baselined (legacy) findings")
+@click.option("--update-baseline", is_flag=True,
+              help="rewrite the baseline to the current findings")
+@click.option("--check", "only_checks", multiple=True,
+              help="run only these checks (repeatable); default: all")
+def lint_cmd(root, baseline_path, fail_on_new, show_all, update_baseline,
+             only_checks):
+    """Run the AST invariant analyzer over the package.
+
+    Checks: host-sync (no hidden device round-trips in ops/ and models/),
+    lock-discipline (guarded state mutated lock-free; inconsistent lock
+    order), config-registry (no raw BST_* environment access outside
+    config.py), metric-name (every bst_* series declared once in
+    observe/metric_names.py). Suppress a single line with
+    `# bst-lint: off=<check>`."""
+    from ..analysis import (
+        ALL_CHECKS,
+        default_baseline_path,
+        default_root,
+        load_baseline,
+        new_findings,
+        run_lint,
+        save_baseline,
+    )
+
+    root = Path(root) if root else default_root()
+    baseline_path = (Path(baseline_path) if baseline_path
+                     else default_baseline_path(root))
+    checks = None
+    if only_checks:
+        unknown = set(only_checks) - set(ALL_CHECKS)
+        if unknown:
+            raise click.ClickException(
+                f"unknown check(s) {sorted(unknown)}; "
+                f"available: {sorted(ALL_CHECKS)}")
+        checks = {k: ALL_CHECKS[k] for k in only_checks}
+    if update_baseline and only_checks:
+        # a partial scan must not rewrite the whole-package baseline:
+        # it would silently drop every other check's tracked entries
+        # and fail the next full tier-1 run on untouched code
+        raise click.ClickException(
+            "--update-baseline needs a full scan; drop --check")
+    findings = run_lint(root, checks=checks)
+    if update_baseline:
+        save_baseline(baseline_path, findings)
+        click.echo(f"baseline updated: {len(findings)} finding(s) -> "
+                   f"{baseline_path}")
+        return
+    baseline = load_baseline(baseline_path)
+    new = new_findings(findings, baseline)
+    shown = findings if show_all else new
+    newset = {id(f) for f in new}
+    for f in shown:
+        tag = "" if id(f) in newset else " (baselined)"
+        click.echo(f.render() + tag)
+    legacy = len(findings) - len(new)
+    click.echo(f"bst lint: {len(new)} new finding(s), "
+               f"{legacy} baselined, {len(findings)} total")
+    if new and fail_on_new:
+        sys.exit(1)
+
+
+@click.command()
+@click.option("--json", "as_json", is_flag=True,
+              help="machine-readable resolved config")
+@click.option("--verbose", "-v", is_flag=True,
+              help="include type, default, consumer and docs per knob")
+def config_cmd(as_json, verbose):
+    """Dump every BST_* knob with its resolved value and source.
+
+    One declaration per variable lives in bigstitcher_spark_tpu/config.py
+    (name, type, default, doc); values are read from the environment at
+    call time, `(env)` marks the ones the environment overrides."""
+    from .. import config
+
+    if as_json:
+        click.echo(_json.dumps(config.resolve(), indent=1, default=str))
+    else:
+        click.echo(config.describe(verbose=verbose))

@@ -10,6 +10,7 @@ from __future__ import annotations
 import click
 
 from . import (
+    analysis_tools,
     detection_tools,
     fusion_tools,
     intensity_tools,
@@ -51,6 +52,8 @@ cli.add_command(utility_tools.map_setup_ids_cmd, "map-setup-ids")
 cli.add_command(utility_tools.env_cmd, "env")
 cli.add_command(utility_tools.serve_container_cmd, "serve-container")
 cli.add_command(telemetry_tools.telemetry_merge_cmd, "telemetry-merge")
+cli.add_command(analysis_tools.lint_cmd, "lint")
+cli.add_command(analysis_tools.config_cmd, "config")
 
 
 def main():

@@ -339,9 +339,14 @@ def env_cmd():
     else:
         click.echo("native codec: NOT built (make -C native; "
                    "tensorstore fallback active, lz4 N5 unreadable)")
-    import os
+    # the full resolved knob surface (defaults vs env overrides) instead
+    # of the single raw BST_NATIVE_IO echo this used to print — `bst
+    # config -v` adds per-knob docs
+    from .. import config
 
-    click.echo(f"BST_NATIVE_IO={os.environ.get('BST_NATIVE_IO', '1')}")
+    click.echo("runtime config (bst config -v for docs; (env) = overridden):")
+    for line in config.describe().splitlines():
+        click.echo(f"  {line}")
     if uris.get_s3_region():
         click.echo(f"s3 region: {uris.get_s3_region()}")
     if uris.get_s3_endpoint():

@@ -1,0 +1,277 @@
+"""Central registry of every ``BST_*`` runtime knob.
+
+The Spark reference centralizes tuning in spark-defaults / ``--conf``;
+here the equivalent surface grew organically as ~22 scattered
+``os.environ`` reads, two of them frozen at import time (io/uris.py) so
+setting them after import was silently ignored. This module is now the
+ONLY place in the package allowed to touch ``os.environ`` for ``BST_*``
+names — ``bst lint`` (analysis/) machine-checks that — and every knob is
+declared exactly once with its type, default and documentation.
+
+Reads go through :func:`get` (or the typed wrappers) and hit the
+environment at CALL time, so tests and long-lived processes can retune
+without re-importing, and ``bst`` subprocesses launched with a mutated
+environment behave the way the caller expects. Unparseable values fall
+back to the declared default (a typo'd budget must not crash a pod run
+mid-stage), matching the historical behavior of the inline reads.
+
+``bst config`` renders :func:`resolve` — every knob, its resolved value,
+and whether it came from the environment or the default — which is also
+what ``bst env`` embeds so diagnostics always show the full surface.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any
+
+# explicit falsy spellings for bool knobs: anything else set-and-nonempty
+# is truthy, so a stray BST_PAIR_SHARD=2 or =true cannot silently flip a
+# feature OFF (the failure mode called out at parallel/pairsched.py)
+_FALSY = frozenset({"0", "false", "no", "off"})
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared ``BST_*`` variable.
+
+    ``kind`` drives parsing: ``str`` verbatim, ``int`` via int(), ``bytes``
+    via int(float()) clamped >= 0 (accepts "2e9"), ``bool`` via the
+    explicit-falsy rule above. ``consumer`` records which layer reads it:
+    ``runtime`` (this package), ``wrapper`` (the ./install shell wrappers),
+    ``bench`` (bench.py / scripts), ``tests`` (the pytest suite) —
+    non-runtime knobs are declared so docs, ``bst config`` and the
+    doc-drift test cover the whole surface, not because the package reads
+    them."""
+
+    name: str
+    kind: str
+    default: Any
+    doc: str
+    consumer: str = "runtime"
+    choices: tuple[str, ...] | None = None
+
+
+KNOBS: dict[str, Knob] = {}
+
+
+def _knob(name: str, kind: str, default, doc: str, *,
+          consumer: str = "runtime", choices=None) -> None:
+    if name in KNOBS:
+        raise ValueError(f"knob {name} declared twice")
+    KNOBS[name] = Knob(name, kind, default, doc, consumer,
+                       tuple(choices) if choices else None)
+
+
+# -- IO / caching ----------------------------------------------------------
+_knob("BST_NATIVE_IO", "bool", True,
+      "Use the native C++ chunk codec (zstd/lz4/raw N5 + zarr v2) for "
+      "GIL-free reads/writes when built; 0 forces tensorstore.")
+_knob("BST_CHUNK_CACHE_BYTES", "bytes", 1 << 30,
+      "Byte budget of the process-wide decoded-chunk LRU cache "
+      "(io/chunkcache.py); 0 disables caching entirely.")
+_knob("BST_TILE_CACHE_BYTES", "bytes", int(2e9),
+      "Byte budget of the HBM-resident composite fusion tile cache keyed "
+      "by dataset signature + write generation; 0 disables.")
+_knob("BST_S3_REGION", "str", None,
+      "Default AWS region for s3:// roots (the reference's --s3Region); "
+      "io.uris.set_s3_region() overrides at runtime.")
+_knob("BST_S3_ENDPOINT", "str", None,
+      "Custom S3-protocol endpoint (MinIO / on-prem stores / test fakes); "
+      "io.uris.set_s3_endpoint() overrides at runtime.")
+
+# -- device memory / dispatch windows --------------------------------------
+_knob("BST_INFLIGHT_BYTES", "bytes", None,
+      "Process-wide byte budget for dispatched-but-undrained device work "
+      "(utils/devicemem.py). Default: derived from the backend's "
+      "memory_stats (60% of free HBM), 2e9 where the runtime reports "
+      "nothing (XLA:CPU).")
+_knob("BST_PAIR_INFLIGHT_BYTES", "bytes", None,
+      "PER-DEVICE byte budget for a pair stage's in-flight work "
+      "(stitching PCM, descriptor/intensity matching). Default: each "
+      "device's own memory_stats-derived budget.")
+_knob("BST_DEVICE_TILE_BUDGET", "bytes", int(4e9),
+      "Device-residency budget for the whole-volume composite fusion "
+      "path (tiles + f32 accumulators must fit or the driver falls back "
+      "to the per-block path).")
+_knob("BST_PER_DEV_BUDGET", "bytes", int(1e9),
+      "Per-device staging budget the fusion drivers use to pack several "
+      "blocks per dispatch (per_dev).")
+_knob("BST_EARLY_DISPATCH", "bool", True,
+      "Allow the sharded work loop to dispatch batches ahead of the one "
+      "currently draining; 0 forces strict one-batch-at-a-time.")
+_knob("BST_PAIR_SHARD", "bool", True,
+      "Spread the pair-parallel stages over every local device "
+      "(parallel/pairsched.py); 0 pins them to one device.")
+
+# -- kernels ---------------------------------------------------------------
+_knob("BST_DOG_BLUR", "str", "auto",
+      "DoG blur strategy: fft (rfftn transfer multiply, the CPU win) or "
+      "gemm (Toeplitz matmuls on the MXU); auto picks per backend.",
+      choices=("auto", "fft", "gemm"))
+
+# -- multi-host runtime ----------------------------------------------------
+_knob("BST_COORDINATOR", "str", None,
+      "host:port of process 0 for jax.distributed multi-host init "
+      "(scripts/pod_launch.sh sets it).")
+_knob("BST_NUM_PROCESSES", "int", None,
+      "World size of the multi-host runtime; also the event-log filename "
+      "fallback before backend init.")
+_knob("BST_PROCESS_ID", "int", None,
+      "This process's rank in the multi-host runtime; event-log filename "
+      "fallback before backend init.")
+_knob("BST_DISTRIBUTED", "bool", False,
+      "On autodetecting platforms (Cloud TPU pods, SLURM): let "
+      "jax.distributed.initialize() discover the topology.")
+
+# -- telemetry -------------------------------------------------------------
+_knob("BST_TELEMETRY_DIR", "str", None,
+      "Telemetry output directory for bench.py runs (CLI tools take "
+      "--telemetry-dir instead).", consumer="bench")
+
+# -- install wrappers ------------------------------------------------------
+_knob("BST_DEVICES", "int", None,
+      "Virtual CPU mesh size (xla_force_host_platform_device_count) "
+      "exported by the ./install shell wrappers — the local[N] analogue.",
+      consumer="wrapper")
+
+# -- bench.py --------------------------------------------------------------
+_knob("BST_BENCH_DIR", "str", "/tmp/bst_bench",
+      "Fixture/working directory for bench.py.", consumer="bench")
+_knob("BST_BENCH_TILE", "int", None,
+      "Override the primary bench config's tile edge (e.g. 384 runs "
+      "(384,384,192) tiles).", consumer="bench")
+_knob("BST_BENCH_CHILD_TIMEOUT", "int", 1500,
+      "Per-child-process timeout (s) for bench.py subprocess runs.",
+      consumer="bench")
+_knob("BST_BENCH_DEVICE_TIMEOUT", "int", 300,
+      "Accelerator-probe timeout (s) for bench.py.", consumer="bench")
+_knob("BST_BENCH_RUNS", "int", 5,
+      "Fusion benchmark repetitions per config.", consumer="bench")
+_knob("BST_BENCH_FRESH_BASELINE", "bool", True,
+      "Re-measure numpy/tensorstore baselines inside every bench run; 0 "
+      "reuses BASELINE_MEASURED.json.", consumer="bench")
+_knob("BST_BENCH_PARTIAL", "str", None,
+      "Path where a bench child process streams partial results "
+      "(set by the bench parent).", consumer="bench")
+_knob("BST_BENCH_CHILD", "bool", False,
+      "Marks a bench subprocess (set by the bench parent).",
+      consumer="bench")
+_knob("BST_BENCH_TPU_ONLY", "bool", False,
+      "Fail the bench run instead of falling back to CPU when the "
+      "accelerator is unreachable.", consumer="bench")
+
+# -- test suite ------------------------------------------------------------
+_knob("BST_TEST_TPU", "bool", False,
+      "Run the pytest suite against the real TPU instead of the forced "
+      "8-device virtual CPU mesh (tests/conftest.py).", consumer="tests")
+_knob("BST_BIG_TESTS", "bool", False,
+      "Enable the slow large-N scaling tests (e.g. the 1e5-descriptor "
+      "matcher case).", consumer="tests")
+
+
+def raw_value(name: str) -> str | None:
+    """The environment string for a DECLARED knob (KeyError otherwise);
+    unset and set-but-empty both read as None. The package's single
+    ``BST_*`` environment touchpoint."""
+    knob = KNOBS[name]
+    v = os.environ.get(knob.name)
+    return None if v is None or v == "" else v
+
+
+def _parse(knob: Knob, raw: str):
+    if knob.kind == "str":
+        if knob.choices and raw not in knob.choices:
+            # raise like any unparseable value so get() falls back AND
+            # source() reports "default" — returning the default here
+            # would make `bst config` label the operator's typo as (env)
+            raise ValueError(f"{raw!r} not in {knob.choices}")
+        return raw
+    if knob.kind == "bool":
+        return raw.strip().lower() not in _FALSY
+    if knob.kind == "int":
+        return int(raw)
+    if knob.kind == "bytes":
+        return max(0, int(float(raw)))
+    if knob.kind == "float":
+        return float(raw)
+    raise AssertionError(f"unknown knob kind {knob.kind}")
+
+
+def get(name: str):
+    """Resolved value of a declared knob, read from the environment at
+    call time; unparseable values fall back to the declared default."""
+    knob = KNOBS[name]
+    raw = raw_value(name)
+    if raw is None:
+        return knob.default
+    try:
+        return _parse(knob, raw)
+    except (ValueError, TypeError):
+        return knob.default
+
+
+def source(name: str) -> str:
+    """Where :func:`get` resolves ``name`` from right now: ``"env"`` or
+    ``"default"`` (unset, empty, or unparseable)."""
+    knob = KNOBS[name]
+    raw = raw_value(name)
+    if raw is None:
+        return "default"
+    try:
+        _parse(knob, raw)
+    except (ValueError, TypeError):
+        return "default"
+    return "env"
+
+
+# typed wrappers: call sites read as what they mean, and the linter can
+# pair each knob with the declared kind
+def get_bool(name: str) -> bool:
+    v = get(name)
+    return bool(v)
+
+
+def get_int(name: str) -> int | None:
+    return get(name)
+
+
+def get_bytes(name: str) -> int | None:
+    return get(name)
+
+
+def get_str(name: str) -> str | None:
+    return get(name)
+
+
+def resolve() -> list[dict]:
+    """Every knob with its resolved value — the ``bst config`` payload."""
+    out = []
+    for name in sorted(KNOBS):
+        k = KNOBS[name]
+        out.append({
+            "name": name,
+            "value": get(name),
+            "source": source(name),
+            "default": k.default,
+            "kind": k.kind,
+            "consumer": k.consumer,
+            "doc": k.doc,
+        })
+    return out
+
+
+def describe(verbose: bool = False) -> str:
+    """Human-readable resolved-config dump (``bst config`` / ``bst env``).
+
+    One line per knob: name, resolved value, and ``(env)`` when the
+    environment overrides the default; ``verbose`` adds the docs."""
+    lines = []
+    for row in resolve():
+        mark = "  (env)" if row["source"] == "env" else ""
+        lines.append(f"{row['name']}={row['value']}{mark}")
+        if verbose:
+            lines.append(f"    [{row['kind']}, default {row['default']!r}, "
+                         f"{row['consumer']}] {row['doc']}")
+    return "\n".join(lines)

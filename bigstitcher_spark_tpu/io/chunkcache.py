@@ -35,15 +35,13 @@ mutate them without any host-visible signal.
 
 from __future__ import annotations
 
-import os
 import threading
 from collections import OrderedDict
 
 import numpy as np
 
+from .. import config
 from ..observe import metrics as _metrics
-
-DEFAULT_BUDGET = 1 << 30
 
 _HITS = _metrics.counter("bst_chunk_cache_hits_total")
 _MISSES = _metrics.counter("bst_chunk_cache_misses_total")
@@ -57,15 +55,9 @@ _CUR_ENTRIES = _metrics.gauge("bst_chunk_cache_entries")
 
 
 def budget_bytes() -> int:
-    """Current byte budget (read from the environment on every call so
-    tests and long-lived processes can retune without restarting)."""
-    raw = os.environ.get("BST_CHUNK_CACHE_BYTES")
-    if raw is None or raw == "":
-        return DEFAULT_BUDGET
-    try:
-        return max(0, int(float(raw)))
-    except ValueError:
-        return DEFAULT_BUDGET
+    """Current byte budget (read through the config registry on every call
+    so tests and long-lived processes can retune without restarting)."""
+    return config.get_bytes("BST_CHUNK_CACHE_BYTES")
 
 
 def enabled() -> bool:

@@ -26,6 +26,7 @@ import numpy as np
 import tensorstore as ts
 
 from . import chunkcache, uris
+from .. import config
 from ..observe import events as _events
 from ..observe import metrics as _metrics
 
@@ -39,8 +40,16 @@ _IO_COUNTERS: dict[tuple[str, str], tuple] = {}
 def _record_io(op: str, via: str, nbytes: int, dataset: str) -> None:
     pair = _IO_COUNTERS.get((op, via))
     if pair is None:
-        pair = (_metrics.counter(f"bst_io_{op}_bytes_total", path=via),
-                _metrics.counter(f"bst_io_{op}_ops_total", path=via))
+        # literal series names per op branch so every metric string is
+        # declared in observe/metric_names.py (the metric-name lint check
+        # bans constructed names — a typo'd op would otherwise mint a
+        # silent zero-valued series)
+        if op == "read":
+            pair = (_metrics.counter("bst_io_read_bytes_total", path=via),
+                    _metrics.counter("bst_io_read_ops_total", path=via))
+        else:
+            pair = (_metrics.counter("bst_io_write_bytes_total", path=via),
+                    _metrics.counter("bst_io_write_ops_total", path=via))
         _IO_COUNTERS[(op, via)] = pair
     pair[0].inc(int(nbytes))
     pair[1].inc()
@@ -511,7 +520,7 @@ class Dataset:
         if (self.reversed_axes or self.store is None
                 or getattr(self.store, "format", None) != StorageFormat.N5
                 or not getattr(self.store, "is_local", False)
-                or os.environ.get("BST_NATIVE_IO", "1") != "1"):
+                or not config.get_bool("BST_NATIVE_IO")):
             return None
         comp = (self._meta_file_cached("attributes.json")
                 or {}).get("compression", {})
@@ -608,7 +617,7 @@ class Dataset:
         if (not self.reversed_axes or self.store is None
                 or getattr(self.store, "format", None) != StorageFormat.ZARR
                 or not getattr(self.store, "is_local", False)
-                or os.environ.get("BST_NATIVE_IO", "1") != "1"):
+                or not config.get_bool("BST_NATIVE_IO")):
             return False
         from . import native_blockio
 
@@ -805,7 +814,7 @@ class ChunkStore:
                 from . import native_blockio
 
                 if not (self.is_local and native_blockio.has_lz4()
-                        and os.environ.get("BST_NATIVE_IO", "1") == "1"):
+                        and config.get_bool("BST_NATIVE_IO")):
                     raise ValueError(
                         "lz4 N5 datasets need a local store and the native "
                         "codec (liblz4, BST_NATIVE_IO enabled)")
@@ -873,14 +882,14 @@ class ChunkStore:
                     raise
                 from . import native_blockio
 
-                native_ok = os.environ.get("BST_NATIVE_IO", "1") == "1"
+                native_ok = config.get_bool("BST_NATIVE_IO")
                 if self.is_local and native_blockio.has_lz4() and native_ok:
                     return Dataset(self, path, None, reversed_axes=False)
                 raise ValueError(
                     f"{path}: lz4-compressed N5 needs the native codec on "
                     f"a local store (liblz4 loaded: "
                     f"{native_blockio.has_lz4()}, local: {self.is_local}, "
-                    f"BST_NATIVE_IO={os.environ.get('BST_NATIVE_IO', '1')})"
+                    f"BST_NATIVE_IO enabled: {config.get_bool('BST_NATIVE_IO')})"
                 ) from e
             return Dataset(self, path, arr, reversed_axes=False)
         spec = {

@@ -12,13 +12,18 @@ from __future__ import annotations
 
 import os
 
-# process-wide region default for s3 kvstores (--s3Region equivalent)
-_S3_REGION: list[str | None] = [os.environ.get("BST_S3_REGION") or None]
+from .. import config
 
-# custom S3-protocol endpoint (MinIO / on-prem object stores / test fakes);
-# also used by tests to drive tensorstore's REAL s3 code path against a
-# local server instead of AWS
-_S3_ENDPOINT: list[str | None] = [os.environ.get("BST_S3_ENDPOINT") or None]
+# Setter overrides for the s3 region/endpoint (--s3Region equivalent and
+# MinIO/on-prem/test-fake endpoints). The sentinel keeps override and
+# environment separate: until a setter runs, every get reads
+# BST_S3_REGION/BST_S3_ENDPOINT through the config registry at CALL time
+# (the old import-time snapshot silently ignored env set after import —
+# exactly what tests and `bst` subprocesses do); an explicit setter call,
+# including set_*(None), wins from then on.
+_UNSET = object()
+_S3_REGION: list = [_UNSET]
+_S3_ENDPOINT: list = [_UNSET]
 
 
 def set_s3_region(region: str | None) -> None:
@@ -26,6 +31,8 @@ def set_s3_region(region: str | None) -> None:
 
 
 def get_s3_region() -> str | None:
+    if _S3_REGION[0] is _UNSET:
+        return config.get_str("BST_S3_REGION")
     return _S3_REGION[0]
 
 
@@ -34,6 +41,8 @@ def set_s3_endpoint(endpoint: str | None) -> None:
 
 
 def get_s3_endpoint() -> str | None:
+    if _S3_ENDPOINT[0] is _UNSET:
+        return config.get_str("BST_S3_ENDPOINT")
     return _S3_ENDPOINT[0]
 
 

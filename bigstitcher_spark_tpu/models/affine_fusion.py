@@ -14,6 +14,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
+import jax
 import numpy as np
 
 from ..io.chunkstore import Dataset
@@ -29,7 +30,7 @@ from ..utils.geometry import (
     transformed_interval,
 )
 from ..utils.grid import GridBlock, create_grid
-from .. import observe, profiling
+from .. import config, observe, profiling
 from ..observe import metrics as _metrics
 
 _H2D_BYTES = _metrics.counter("bst_xfer_h2d_bytes_total")
@@ -197,7 +198,7 @@ def fuse_grid_block(
             block_shape=bshape, fusion_type=fusion_type, inside_offs=ioffs,
             coeffs=coeffs, coeff_affines=coeff_affs,
         )
-        fused, wsum = np.asarray(fused), np.asarray(wsum)
+        fused, wsum = jax.device_get((fused, wsum))
     # crop the static compute shape back to the (possibly clipped) block
     sl = tuple(slice(0, s) for s in block.size)
     return fused[sl], wsum[sl]
@@ -343,7 +344,7 @@ def _fuse_sep_path(sd, loader, plans, block, bshape, fusion_type, blend,
             patches, diags, ts, offsets, img_dims, borders, ranges, valid,
             block_shape=bshape, fusion_type=fusion_type, inside_offs=ioffs,
         )
-        fused, wsum = np.asarray(fused), np.asarray(wsum)
+        fused, wsum = jax.device_get((fused, wsum))
     sl = tuple(slice(0, s) for s in block.size)
     return fused[sl], wsum[sl]
 
@@ -363,14 +364,15 @@ def _fuse_shift_path(loader, plans, block, block_global, bshape, fusion_type,
             patches, fracs, lpos0, img_dims, borders, ranges, valid,
             block_shape=bshape, fusion_type=fusion_type, inside_offs=ioffs,
         )
-        fused, wsum = np.asarray(fused), np.asarray(wsum)
+        fused, wsum = jax.device_get((fused, wsum))
     sl = tuple(slice(0, s) for s in block.size)
     return fused[sl], wsum[sl]
 
 
-DEVICE_TILE_BUDGET_BYTES = int(
-    float(__import__("os").environ.get("BST_DEVICE_TILE_BUDGET", 4e9))
-)
+def device_tile_budget_bytes() -> int:
+    """Composite-path device residency budget, read at call time (the old
+    import-time snapshot ignored BST_DEVICE_TILE_BUDGET set after import)."""
+    return config.get_bytes("BST_DEVICE_TILE_BUDGET")
 
 
 @dataclass
@@ -427,14 +429,14 @@ def plan_composite_volume(
     # counted against the plan (this plan's own cached tiles are the very
     # buffers `nbytes` already prices).
     nbytes += 3 * int(np.prod(bbox.shape)) * 4
-    if nbytes > DEVICE_TILE_BUDGET_BYTES:
+    budget = device_tile_budget_bytes()
+    if nbytes > budget:
         return None
     own_keys = {k for k in (_tile_cache_key(loader.open(p.view, 0))
                             for p in plans) if k is not None}
     with _TILE_CACHE_LOCK:
         for k in [k for k in _TILE_CACHE if k not in own_keys]:
-            if (nbytes + _TILE_CACHE_BYTES[0]
-                    <= DEVICE_TILE_BUDGET_BYTES):
+            if nbytes + _TILE_CACHE_BYTES[0] <= budget:
                 break
             _tile_cache_drop_locked(k)
 
@@ -512,13 +514,7 @@ _TILE_CACHE_BYTES = [0]
 
 
 def _tile_cache_budget() -> int:
-    raw = __import__("os").environ.get("BST_TILE_CACHE_BYTES")
-    if raw is None or raw == "":
-        return int(2e9)
-    try:
-        return max(0, int(float(raw)))
-    except ValueError:
-        return int(2e9)
+    return config.get_bytes("BST_TILE_CACHE_BYTES")
 
 
 def _tile_cache_key(ds) -> tuple | None:
@@ -803,8 +799,7 @@ def _fuse_volume_sharded(
                     [c + 1 for c in compute_block])) * 4
             else:
                 item_bytes = vb * int(np.prod(key[1])) * 4
-            budget = int(float(__import__("os").environ.get(
-                "BST_PER_DEV_BUDGET", 1e9)))
+            budget = config.get_bytes("BST_PER_DEV_BUDGET")
             per_dev = max(1, min(4, len(items) // max(n_dev, 1),
                                  budget // max(item_bytes, 1)))
             run_sharded_batches(
@@ -943,7 +938,7 @@ def fuse_volume(
                 out *= float(np.iinfo(np.dtype(out_dtype)).max)
             data = out.astype(out_dtype)
         else:
-            data = np.asarray(
+            data = jax.device_get(
                 F.convert_intensity(
                     fused, np.float32(min_intensity), np.float32(max_intensity),
                     out_dtype=out_dtype,

@@ -125,7 +125,10 @@ class _ViewPlan:
         raw = self.read_raw_block(loader, offset, shape)
         if all(r == 1 for r in self.rel):
             return raw.astype(np.float32)
-        return np.asarray(downsample_block(raw.astype(np.float32), self.rel))
+        import jax
+
+        return jax.device_get(
+            downsample_block(raw.astype(np.float32), self.rel))
 
 
 def _read_mirror(loader: ViewLoader, view, level, offset, shape) -> np.ndarray:

@@ -35,8 +35,11 @@ def read_padded(src_read, src_shape, src_off, src_size) -> "np.ndarray":
 
 def downsample_read(src_read, src_shape, src_off, src_size, factors) -> "np.ndarray":
     """read_padded + average-downsample by ``factors``."""
+    import jax
+
     data = read_padded(src_read, src_shape, src_off, src_size)
-    return np.asarray(downsample_block(data, tuple(int(f) for f in factors)))
+    return jax.device_get(
+        downsample_block(data, tuple(int(f) for f in factors)))
 
 
 def _convert_to_dtype(out: np.ndarray, dtype) -> np.ndarray:

@@ -204,6 +204,7 @@ def match_pair(
             params.ransac_max_epsilon, params.ransac_min_inlier_ratio,
             params.ransac_min_inliers, params.ransac_iterations, seed=seed,
         )
+        # bst-lint: off=host-sync (ransac_multi returns a host list)
         if not sets:
             return np.zeros((0, 2), np.int32), None, len(cand)
         union = np.zeros(len(cand), bool)

@@ -159,7 +159,7 @@ def _downsample_crop(crop: np.ndarray, ds: Sequence[int]) -> np.ndarray:
     pad = [(0, (-crop.shape[d]) % int(ds[d])) for d in range(3)]
     if any(p[1] for p in pad):
         crop = np.pad(crop, pad, mode="edge")
-    return np.asarray(downsample_block(crop, tuple(int(f) for f in ds)))
+    return jax.device_get(downsample_block(crop, tuple(int(f) for f in ds)))
 
 
 @dataclass

@@ -32,8 +32,10 @@ def world() -> tuple[int, int]:
 
         return _w()
     except Exception:
-        return (int(os.environ.get("BST_PROCESS_ID", "0") or 0),
-                int(os.environ.get("BST_NUM_PROCESSES", "1") or 1))
+        from .. import config
+
+        return (config.get_int("BST_PROCESS_ID") or 0,
+                config.get_int("BST_NUM_PROCESSES") or 1)
 
 
 def event_log_name(process_index: int, process_count: int) -> str:

@@ -1,0 +1,68 @@
+"""Registry of every ``bst_*`` metric series name.
+
+A typo'd metric string fails silently: the registry happily creates a
+fresh zero-valued series, dashboards and BENCH columns read the intended
+name, and the counter "works" while reporting nothing. Declaring every
+name exactly once here — and lint-enforcing (analysis/checks.py,
+``metric-name``) that any ``bst_*`` string literal elsewhere in the
+package appears in this table — turns that silent drift into a tier-1
+test failure.
+
+Keys are the exposition names; values are one-line help strings (also
+usable as Prometheus # HELP text). Names follow prometheus conventions:
+``_total`` for counters, unit suffixes (``_bytes``, ``_ms``, ``_seconds``,
+``_pct``) for everything else.
+"""
+
+from __future__ import annotations
+
+METRICS: dict[str, str] = {
+    # chunk IO (io/chunkstore.py), labeled by path taken
+    "bst_io_read_bytes_total": "bytes read per (op, implementation path)",
+    "bst_io_read_ops_total": "chunk-level read operations per path",
+    "bst_io_write_bytes_total": "bytes written per (op, implementation path)",
+    "bst_io_write_ops_total": "chunk-level write operations per path",
+    # decoded-chunk LRU cache (io/chunkcache.py)
+    "bst_chunk_cache_hits_total": "decoded-chunk cache hits",
+    "bst_chunk_cache_misses_total": "decoded-chunk cache misses",
+    "bst_chunk_cache_hit_bytes_total": "bytes served from the chunk cache",
+    "bst_chunk_cache_miss_bytes_total": "bytes decoded on cache miss",
+    "bst_chunk_cache_evictions_total": "chunk-cache LRU evictions",
+    "bst_chunk_cache_evict_bytes_total": "bytes evicted from the chunk cache",
+    "bst_chunk_cache_invalidations_total":
+        "chunk-cache entries dropped by write/remove invalidation",
+    "bst_chunk_cache_bytes": "current chunk-cache resident bytes",
+    "bst_chunk_cache_entries": "current chunk-cache entry count",
+    # host<->device transfers (parallel/mesh.py, models/, ops drivers)
+    "bst_xfer_h2d_bytes_total": "host-to-device bytes shipped",
+    "bst_xfer_d2h_bytes_total": "device-to-host bytes fetched",
+    "bst_xfer_h2d_bytes_saved_total":
+        "H2D bytes avoided by native-dtype transport (vs f32 upload)",
+    "bst_xfer_d2h_bytes_saved_total":
+        "D2H bytes avoided by on-device output conversion",
+    # HBM-resident composite tile cache (models/affine_fusion.py)
+    "bst_tile_cache_hits_total": "composite tile cache hits",
+    "bst_tile_cache_misses_total": "composite tile cache misses",
+    "bst_tile_cache_hit_bytes_total": "tile bytes served device-resident",
+    "bst_tile_cache_evict_bytes_total": "tile bytes evicted from HBM",
+    # in-flight dispatch window (utils/devicemem.py)
+    "bst_inflight_bytes": "bytes currently dispatched but not drained",
+    "bst_inflight_bytes_highwater": "high-water mark of in-flight bytes",
+    # retry layer (parallel/retry.py)
+    "bst_retry_rounds_total": "block retry rounds executed",
+    "bst_blocks_failed_total": "blocks that failed (per exception class)",
+    # multi-host barriers (parallel/distributed.py)
+    "bst_barrier_seconds": "per-name barrier wait time histogram",
+    # stage progress (observe/progress.py)
+    "bst_stage_items_done_total": "work items completed per stage",
+    # pair-parallel scheduler (parallel/pairsched.py)
+    "bst_pair_dispatch_total": "pair tasks dispatched per (stage, device)",
+    "bst_pair_busy_ms_total": "device busy milliseconds per (stage, device)",
+    "bst_pair_redispatch_total":
+        "pair tasks re-dispatched after a device failure",
+    "bst_pair_device_util_pct": "stage device-utilization percentage",
+}
+
+
+def declared() -> frozenset[str]:
+    return frozenset(METRICS)

@@ -439,6 +439,7 @@ def ransac(
     model = fit_interpolated(model_kind, reg_kind, lam,
                              np.asarray(cand_a, np.float64)[inliers],
                              np.asarray(cand_b, np.float64)[inliers])
+    # bst-lint: off=host-sync (fit_interpolated xp=np: host f64 refit)
     return np.asarray(model, np.float64), inliers
 
 

@@ -123,9 +123,9 @@ def _dog_response_fft(x: jnp.ndarray, k1, k2) -> jnp.ndarray:
 def _blur_strategy() -> str:
     """'fft' on CPU, 'gemm' (Toeplitz matmuls on the MXU) elsewhere;
     BST_DOG_BLUR=fft|gemm overrides. Read at trace time — fixed per process."""
-    import os
+    from .. import config
 
-    mode = os.environ.get("BST_DOG_BLUR", "auto")
+    mode = config.get_str("BST_DOG_BLUR")
     if mode == "auto":
         return "fft" if jax.default_backend() == "cpu" else "gemm"
     return mode

@@ -28,7 +28,6 @@ should run single-process; the block-writing stages are where the volume is.
 
 from __future__ import annotations
 
-import os
 from typing import Sequence
 
 _initialized = [False]
@@ -46,15 +45,24 @@ def init_distributed(
     False for the ordinary single-process case (no env, no args)."""
     if _initialized[0]:
         return True
-    coordinator_address = coordinator_address or os.environ.get("BST_COORDINATOR")
-    if num_processes is None and os.environ.get("BST_NUM_PROCESSES"):
-        num_processes = int(os.environ["BST_NUM_PROCESSES"])
-    if process_id is None and os.environ.get("BST_PROCESS_ID"):
-        process_id = int(os.environ["BST_PROCESS_ID"])
+    from .. import config
+
+    coordinator_address = (coordinator_address
+                           or config.get_str("BST_COORDINATOR"))
+    # topology knobs parse via raw_value + int() so a malformed value
+    # aborts the launch loudly — config.get's unparseable-falls-back rule
+    # would silently run this host single-process while the rest of the
+    # pod blocks at the first barrier
+    raw_np = config.raw_value("BST_NUM_PROCESSES")
+    if num_processes is None and raw_np is not None:
+        num_processes = int(raw_np)
+    raw_pid = config.raw_value("BST_PROCESS_ID")
+    if process_id is None and raw_pid is not None:
+        process_id = int(raw_pid)
     import jax
 
     if coordinator_address is None and num_processes is None:
-        if os.environ.get("BST_DISTRIBUTED"):
+        if config.get_bool("BST_DISTRIBUTED"):
             # Cloud TPU pod / SLURM: topology autodetected by jax
             jax.distributed.initialize()
             _initialized[0] = True

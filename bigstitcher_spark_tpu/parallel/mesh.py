@@ -19,7 +19,6 @@ out).
 from __future__ import annotations
 
 import functools
-import os
 
 from typing import Sequence
 
@@ -30,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import fusion as F
 from ..observe import metrics as _metrics
-from .. import observe
+from .. import config, observe
 
 BLOCK_AXIS = "blocks"
 
@@ -253,7 +252,7 @@ def run_sharded_batches(
         """Dispatch every staged later batch that fits the byte budget, so
         the device computes ahead while batch ``bi`` drains; keep host
         prefetch one batch past the dispatch frontier."""
-        if os.environ.get("BST_EARLY_DISPATCH", "1") != "1":
+        if not config.get_bool("BST_EARLY_DISPATCH"):
             # opting out of dispatch-ahead must NOT kill host-side build
             # prefetch — the next batch still stages while this one drains
             nxt = bi + 1

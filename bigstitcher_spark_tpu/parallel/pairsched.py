@@ -53,13 +53,13 @@ one local device degrades to the same thing automatically.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 
 from dataclasses import dataclass
 from typing import Any, Callable, Sequence
 
+from .. import config
 from ..observe import events, metrics as _metrics, progress as _progress
 from .retry import RetryError
 
@@ -80,10 +80,10 @@ def pair_devices(n_devices: int | None = None, devices=None) -> list:
     import jax
 
     devs = list(devices) if devices is not None else list(jax.local_devices())
-    # only explicit falsy spellings opt out — a stray BST_PAIR_SHARD=2 or
-    # =true must not silently collapse every pair stage to one device
-    if os.environ.get("BST_PAIR_SHARD", "1").strip().lower() in (
-            "0", "false", "no", "off"):
+    # only explicit falsy spellings opt out (config.get_bool's rule) — a
+    # stray BST_PAIR_SHARD=2 or =true must not silently collapse every
+    # pair stage to one device
+    if not config.get_bool("BST_PAIR_SHARD"):
         devs = devs[:1]
     if n_devices is not None:
         devs = devs[: max(1, int(n_devices))]

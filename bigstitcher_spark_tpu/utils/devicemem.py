@@ -13,9 +13,9 @@ report nothing.
 
 from __future__ import annotations
 
-import os
 import threading
 
+from .. import config
 from ..observe import metrics as _metrics
 
 # fallback when the backend reports no memory stats: two batches at the
@@ -39,12 +39,9 @@ def _derived_budget(device=None) -> tuple[int, str]:
     ``BST_INFLIGHT_BYTES``), ``"stats"`` (the device's own
     ``memory_stats``, genuinely per device) or ``"fallback"`` (the
     backend reported nothing)."""
-    env = os.environ.get("BST_INFLIGHT_BYTES")
-    if env:
-        try:
-            return max(0, int(float(env))), "env"
-        except ValueError:
-            pass
+    env = config.get_bytes("BST_INFLIGHT_BYTES")
+    if env is not None:
+        return env, "env"
     try:
         import jax
 
@@ -79,12 +76,9 @@ def pair_budget_bytes(device=None, n_local: int = 1) -> int:
     ``BST_INFLIGHT_BYTES`` env, the no-stats fallback) are SPLIT across
     the workers — N workers must not each claim the whole process
     budget."""
-    env = os.environ.get("BST_PAIR_INFLIGHT_BYTES")
-    if env:
-        try:
-            return max(0, int(float(env)))
-        except ValueError:
-            pass
+    env = config.get_bytes("BST_PAIR_INFLIGHT_BYTES")
+    if env is not None:
+        return env
     budget, source = _derived_budget(device)
     if source != "stats":
         budget = max(1, budget // max(n_local, 1))
@@ -117,4 +111,7 @@ class InflightWindow:
 
     def release(self, nbytes: int) -> None:
         self.inflight = max(0, self.inflight - nbytes)
-        _INFLIGHT.inc(-nbytes)
+        # under _LOCK like charge(): a bare dec racing a charge's
+        # read-modify-write of the high-water pair could under-record it
+        with _LOCK:
+            _INFLIGHT.inc(-nbytes)

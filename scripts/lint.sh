@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Tier-1 static-analysis gate: fails on any non-baselined bst-lint finding.
+# Same checks/baseline as tests/test_lint.py and `bst lint`; run from
+# anywhere. Extra args pass through (e.g. --all, --check host-sync).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m bigstitcher_spark_tpu.cli.main lint --fail-on-new "$@"

@@ -27,8 +27,10 @@ def test_installer_covers_every_cli_tool(installed_bin):
     from bigstitcher_spark_tpu.cli.main import cli
 
     wrappers = set(os.listdir(installed_bin))
-    # `env` installs as bst-env (avoids shadowing /usr/bin/env)
-    expected = {t if t != "env" else "bst-env" for t in set(cli.commands)}
+    # generic names install bst- prefixed (a bare `env`/`lint`/`config`
+    # on PATH would shadow /usr/bin/env or unrelated same-named tools)
+    renamed = {"env": "bst-env", "lint": "bst-lint", "config": "bst-config"}
+    expected = {renamed.get(t, t) for t in set(cli.commands)}
     missing = expected - wrappers
     assert not missing, f"installer missing wrappers for: {sorted(missing)}"
 

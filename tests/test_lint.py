@@ -1,0 +1,443 @@
+"""Tier-1 gate for the AST invariant analyzer (``bst lint``) and the
+runtime-config registry.
+
+Three layers: (1) the live package must produce ZERO non-baselined
+findings (and the baseline must not hide ops/models host-sync bugs);
+(2) the analyzer itself is tested against fixture snippets with known
+violations per check, a clean fixture, and suppression comments;
+(3) doc drift — every ``BST_*`` name in README/WORKFLOW/PERF exists in
+the config registry and vice versa."""
+
+import os
+import shutil
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from bigstitcher_spark_tpu import config
+from bigstitcher_spark_tpu.analysis import (
+    baseline_counts,
+    default_baseline_path,
+    default_root,
+    load_baseline,
+    new_findings,
+    run_lint,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _write_tree(root: Path, files: dict[str, str]) -> Path:
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src).lstrip("\n"), encoding="utf-8")
+    return root
+
+
+# -- layer 1: the live package ---------------------------------------------
+
+
+class TestPackageIsClean:
+    def test_zero_new_findings(self):
+        findings = run_lint(default_root())
+        baseline = load_baseline(default_baseline_path())
+        new = new_findings(findings, baseline)
+        assert not new, "new bst-lint findings:\n" + "\n".join(
+            f.render() for f in new)
+
+    def test_baseline_hides_no_ops_models_host_sync(self):
+        # the ISSUE's contract: host-sync findings in ops/ and models/
+        # are FIXED, never baselined away
+        baseline = load_baseline(default_baseline_path())
+        bad = [k for k in baseline
+               if k.startswith(("host-sync|ops/", "host-sync|models/"))]
+        assert not bad, bad
+
+    def test_inserted_violations_fail(self, tmp_path):
+        # the enforcement proof: copy the package, insert a raw
+        # os.environ["BST_X"] read and an unlocked mutation of a
+        # lock-guarded dict, and the scan must produce new findings
+        src = default_root()
+        dst = tmp_path / "pkg"
+        shutil.copytree(src, dst, ignore=shutil.ignore_patterns(
+            "__pycache__", "*.pyc"))
+        uris = dst / "io" / "uris.py"
+        uris.write_text(uris.read_text(encoding="utf-8") + (
+            "\n\ndef _sneaky():\n"
+            "    import os\n"
+            "    return os.environ[\"BST_X\"]\n"), encoding="utf-8")
+        progress = dst / "observe" / "progress.py"
+        progress.write_text(progress.read_text(encoding="utf-8") + (
+            "\n\ndef _unlocked_drop():\n"
+            "    _records.clear()\n"), encoding="utf-8")
+        findings = run_lint(dst)
+        new = new_findings(findings, load_baseline(default_baseline_path()))
+        checks = {f.check for f in new}
+        assert "config-registry" in checks, [f.render() for f in new]
+        assert "lock-discipline" in checks, [f.render() for f in new]
+
+
+# -- layer 2: the analyzer against known fixtures --------------------------
+
+
+class TestHostSyncCheck:
+    def test_known_violations(self, tmp_path):
+        _write_tree(tmp_path, {"ops/mod.py": """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+
+            def bad(x):
+                y = jnp.sum(x)
+                z = float(y)                      # line 8
+                a = np.asarray(jnp.fft.rfftn(x))  # line 9
+                if y > 0:                         # line 10
+                    pass
+                v = y.item()                      # line 12
+                return z, a, v
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "host-sync"]
+        assert sorted(f.line for f in fs) == [8, 9, 10, 12]
+
+    def test_drain_points_are_clean(self, tmp_path):
+        _write_tree(tmp_path, {"ops/mod.py": """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+
+            def good(x):
+                y = jnp.sum(x)
+                z = float(jax.device_get(y))
+                a = np.asarray(jax.device_get(jnp.fft.rfftn(x)))
+                r = jnp.dot(x, x).block_until_ready()
+                n = int(x.shape[0])          # .shape never syncs
+                return z, a, n, np.asarray(r)
+            """})
+        assert [f for f in run_lint(tmp_path) if f.check == "host-sync"] == []
+
+    def test_ops_kernel_results_are_sources(self, tmp_path):
+        # the ADVICE r5 bug class: np.asarray on a kernel-layer result
+        _write_tree(tmp_path, {"models/driver.py": """
+            import numpy as np
+            from ..ops import fusion as F
+
+
+            def drive(p):
+                fused, wsum = F.fuse_block(p)
+                return np.asarray(fused), np.asarray(wsum)
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "host-sync"]
+        assert len(fs) == 2 and all(f.line == 7 for f in fs)
+
+    def test_outside_ops_models_not_scanned(self, tmp_path):
+        _write_tree(tmp_path, {"cli/tool.py": """
+            import jax.numpy as jnp
+
+
+            def show(x):
+                return float(jnp.sum(x))    # CLI boundary: fetch is fine
+            """})
+        assert [f for f in run_lint(tmp_path) if f.check == "host-sync"] == []
+
+
+class TestLockDisciplineCheck:
+    def test_unlocked_mutation(self, tmp_path):
+        _write_tree(tmp_path, {"mod.py": """
+            import threading
+
+            _LOCK = threading.Lock()
+            _STATE = {}
+
+
+            def locked(k, v):
+                with _LOCK:
+                    _STATE[k] = v
+
+
+            def unlocked(k, v):
+                _STATE[k] = v               # line 13
+
+
+            def drop_locked(k):
+                _STATE.pop(k)               # *_locked: caller holds it
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "lock-discipline"]
+        assert [f.line for f in fs] == [13]
+
+    def test_instance_state(self, tmp_path):
+        _write_tree(tmp_path, {"mod.py": """
+            import threading
+
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []        # __init__ is exempt
+
+                def add(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def sneak(self, x):
+                    self._items.append(x)   # line 14
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "lock-discipline"]
+        assert [f.line for f in fs] == [14]
+
+    def test_inconsistent_lock_order(self, tmp_path):
+        _write_tree(tmp_path, {"mod.py": """
+            import threading
+
+            LOCK_A = threading.Lock()
+            LOCK_B = threading.Lock()
+
+
+            def one():
+                with LOCK_A:
+                    with LOCK_B:
+                        pass
+
+
+            def two():
+                with LOCK_B:
+                    with LOCK_A:
+                        pass
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "lock-discipline"]
+        assert len(fs) == 1 and "inconsistent lock order" in fs[0].message
+
+
+class TestConfigRegistryCheck:
+    def test_raw_reads_flagged(self, tmp_path):
+        _write_tree(tmp_path, {"mod.py": """
+            import os
+
+
+            def f():
+                a = os.environ.get("BST_FOO")        # line 5
+                b = os.environ["BST_BAR"]            # line 6
+                c = os.getenv("HOME")                # non-BST: fine
+                d = __import__("os").environ.get("BST_BAZ")  # line 8
+                return a, b, c, d
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "config-registry"]
+        assert sorted(f.line for f in fs) == [5, 6, 8]
+
+    def test_undeclared_knob_flagged(self, tmp_path):
+        _write_tree(tmp_path, {
+            "config.py": """
+                KNOBS = {}
+
+
+                def _knob(name, kind, default, doc):
+                    KNOBS[name] = (kind, default, doc)
+
+
+                _knob("BST_REAL", "str", None, "declared")
+                """,
+            "mod.py": """
+                from . import config
+
+
+                def f():
+                    return config.get_str("BST_TYPO")   # line 5
+                """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "config-registry"]
+        assert [f.line for f in fs] == [5]
+        assert "BST_TYPO" in fs[0].message
+
+    def test_config_py_itself_exempt(self, tmp_path):
+        _write_tree(tmp_path, {"config.py": """
+            import os
+
+
+            def raw_value(name):
+                return os.environ.get(name)
+            """})
+        assert [f for f in run_lint(tmp_path)
+                if f.check == "config-registry"] == []
+
+
+class TestMetricNameCheck:
+    FILES = {
+        "observe/metric_names.py": """
+            METRICS = {
+                "bst_good_total": "a declared counter",
+            }
+            """,
+    }
+
+    def test_unregistered_and_dynamic(self, tmp_path):
+        _write_tree(tmp_path, {**self.FILES, "mod.py": """
+            from observe import metrics as _metrics
+
+            C = _metrics.counter("bst_good_total")
+            D = _metrics.counter("bst_typo_total")     # line 4
+
+
+            def g(name):
+                return _metrics.gauge(name)            # line 8: dynamic
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "metric-name"]
+        assert sorted(f.line for f in fs) == [4, 8]
+
+    def test_duplicate_declaration(self, tmp_path):
+        _write_tree(tmp_path, {"observe/metric_names.py": """
+            METRICS = {
+                "bst_twice_total": "one",
+                "bst_twice_total": "two",
+            }
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "metric-name"]
+        assert len(fs) == 1 and "more than once" in fs[0].message
+
+
+class TestSuppressionAndBaseline:
+    def test_clean_fixture_zero_findings(self, tmp_path):
+        _write_tree(tmp_path, {
+            "ops/k.py": """
+                import jax
+                import jax.numpy as jnp
+
+
+                def kernel(x):
+                    return jnp.sum(x * 2.0)
+
+
+                def drain(x):
+                    return jax.device_get(kernel(x))
+                """,
+            "store.py": """
+                import threading
+
+                _LOCK = threading.Lock()
+                _CACHE = {}
+
+
+                def put(k, v):
+                    with _LOCK:
+                        _CACHE[k] = v
+                """})
+        assert run_lint(tmp_path) == []
+
+    def test_suppression_same_line_and_line_above(self, tmp_path):
+        _write_tree(tmp_path, {"mod.py": """
+            import os
+
+
+            def f():
+                a = os.environ.get("BST_A")  # bst-lint: off=config-registry
+                # bst-lint: off (reason documented here)
+                b = os.environ.get("BST_B")
+                c = os.environ.get("BST_C")  # wrong check name:
+                # stays flagged
+                return a, b, c
+            """})
+        fs = run_lint(tmp_path)
+        assert [f.line for f in fs] == [8]
+
+    def test_suppression_is_per_check(self, tmp_path):
+        _write_tree(tmp_path, {"mod.py": """
+            import os
+
+
+            def f():
+                return os.environ.get("BST_A")  # bst-lint: off=host-sync
+            """})
+        assert [f.check for f in run_lint(tmp_path)] == ["config-registry"]
+
+    def test_baseline_counts_admit_legacy_only(self, tmp_path):
+        _write_tree(tmp_path, {"mod.py": """
+            import os
+
+
+            def f():
+                return os.environ.get("BST_A")
+            """})
+        fs = run_lint(tmp_path)
+        assert len(fs) == 1
+        baseline = baseline_counts(fs)
+        assert new_findings(fs, baseline) == []
+        # a second identical occurrence is NEW relative to count 1
+        assert len(new_findings(fs + fs, baseline)) == 1
+
+
+# -- layer 3: config registry behavior + doc drift -------------------------
+
+
+class TestConfigRegistry:
+    def test_call_time_reads(self, monkeypatch):
+        monkeypatch.delenv("BST_CHUNK_CACHE_BYTES", raising=False)
+        assert config.get_bytes("BST_CHUNK_CACHE_BYTES") == 1 << 30
+        monkeypatch.setenv("BST_CHUNK_CACHE_BYTES", "2e9")
+        assert config.get_bytes("BST_CHUNK_CACHE_BYTES") == int(2e9)
+        assert config.source("BST_CHUNK_CACHE_BYTES") == "env"
+
+    def test_bool_explicit_falsy_rule(self, monkeypatch):
+        for raw, want in [("0", False), ("false", False), ("off", False),
+                          ("no", False), ("1", True), ("true", True),
+                          ("2", True)]:
+            monkeypatch.setenv("BST_PAIR_SHARD", raw)
+            assert config.get_bool("BST_PAIR_SHARD") is want, raw
+
+    def test_unparseable_falls_back_to_default(self, monkeypatch):
+        monkeypatch.setenv("BST_BENCH_RUNS", "not-a-number")
+        assert config.get_int("BST_BENCH_RUNS") == 5
+        assert config.source("BST_BENCH_RUNS") == "default"
+
+    def test_undeclared_name_raises(self):
+        with pytest.raises(KeyError):
+            config.get("BST_NOT_A_KNOB")
+
+    def test_uris_read_env_at_call_time(self, monkeypatch):
+        # the io/uris.py import-time-snapshot bug: env set AFTER import
+        # must be visible (and the setter must still override)
+        from bigstitcher_spark_tpu.io import uris
+
+        monkeypatch.setattr(uris, "_S3_REGION", [uris._UNSET])
+        monkeypatch.setenv("BST_S3_REGION", "eu-central-1")
+        assert uris.get_s3_region() == "eu-central-1"
+        spec = uris.kvstore_spec("s3://bucket/root")
+        assert spec["aws_region"] == "eu-central-1"
+        uris.set_s3_region("us-west-2")
+        assert uris.get_s3_region() == "us-west-2"
+        uris.set_s3_region(None)    # explicit clear beats the env
+        assert uris.get_s3_region() is None
+        monkeypatch.setattr(uris, "_S3_ENDPOINT", [uris._UNSET])
+        monkeypatch.setenv("BST_S3_ENDPOINT", "http://127.0.0.1:9000")
+        assert uris.get_s3_endpoint() == "http://127.0.0.1:9000"
+
+    def test_resolve_covers_every_knob(self):
+        rows = config.resolve()
+        assert {r["name"] for r in rows} == set(config.KNOBS)
+        assert all(r["doc"] for r in rows)
+
+
+class TestDocDrift:
+    DOCS = ("README.md", "WORKFLOW.md", "PERF.md")
+
+    def _doc_names(self):
+        import re
+
+        names: set[str] = set()
+        for doc in self.DOCS:
+            text = (REPO / doc).read_text(encoding="utf-8")
+            names |= set(re.findall(r"\bBST_[A-Z0-9_]+\b", text))
+        return names
+
+    def test_every_doc_name_is_declared(self):
+        undeclared = self._doc_names() - set(config.KNOBS)
+        assert not undeclared, (
+            f"docs mention undeclared knobs: {sorted(undeclared)} — "
+            f"declare them in bigstitcher_spark_tpu/config.py or fix "
+            f"the docs")
+
+    def test_every_knob_is_documented(self):
+        undocumented = set(config.KNOBS) - self._doc_names()
+        assert not undocumented, (
+            f"knobs missing from {self.DOCS}: {sorted(undocumented)} — "
+            f"add them to the README configuration table")
