@@ -454,7 +454,7 @@ def _spans_snapshot():
     from bigstitcher_spark_tpu import profiling
 
     return {k: {"count": s.count, "total_s": round(s.total_s, 3),
-                "max_s": round(s.max_s, 3)}
+                "max_s": round(s.max_s, 3), "min_s": round(s.min_s, 3)}
             for k, s in profiling.get().stats().items()}
 
 
@@ -476,7 +476,7 @@ def _io_snapshot(baseline):
             for k, v in delta.items()
             if k.startswith(("bst_io_", "bst_xfer_", "bst_chunk_cache_",
                              "bst_tile_cache_", "bst_inflight_",
-                             "bst_pair_"))
+                             "bst_pair_", "bst_trace_"))
             and isinstance(v, (int, float)) and v}
 
 
@@ -1398,6 +1398,13 @@ def _finalize(result, truncated=None):
                          params={"platform": result.get("platform"),
                                  "truncated": truncated},
                          status="truncated" if truncated else "ok")
+        # BST_TRACE without a telemetry dir: flush the ring ourselves
+        # (with one, observe.finalize archived it next to the manifest)
+        from bigstitcher_spark_tpu.observe import trace
+
+        tp = trace.finalize(dir_hint=_cfg.get_str("BST_TELEMETRY_DIR"))
+        if tp:
+            _log(f"trace -> {tp}")
     except Exception as e:  # telemetry must never void the artifact
         _log(f"telemetry finalize failed: {e!r}")
     drift = _baseline_drift_flags()
@@ -1430,6 +1437,12 @@ def child_main():
         # same registry/event/manifest path as `bst ... --telemetry-dir`;
         # profiling stays under the bench's own enable/reset control
         observe.configure(_cfg.get_str("BST_TELEMETRY_DIR"), profile=False)
+    if _cfg.get_bool("BST_TRACE"):
+        from bigstitcher_spark_tpu.observe import trace
+
+        # observe.finalize() archives the ring next to the run manifest
+        # when BST_TELEMETRY_DIR is set; else it lands at BST_TRACE_PATH
+        trace.configure()
     xml = build_fixture()
     _log("fixture ready")
     out = os.path.join(FIXTURE, "fused.ome.zarr")

@@ -38,6 +38,16 @@ Checks
     ``observe/metric_names.py`` (a typo'd counter otherwise reports zero
     forever), metric constructors must be called with literal names, and
     the registry itself must declare each name exactly once.
+
+``span-name``
+    The trace/span twin of ``metric-name``: every name passed to
+    ``profiling.span`` / ``trace.span`` / ``trace.instant`` must be a
+    literal declared once in ``observe/metric_names.py``'s ``SPANS``
+    table. Dynamic span-name construction is banned outright — a
+    constructed name fractures both the span aggregates and the
+    flight-recorder timeline into unmergeable series; dynamic identity
+    (device, block offset, pair id, bytes) belongs in the attribution
+    kwargs.
 """
 
 from __future__ import annotations
@@ -673,9 +683,97 @@ def check_metric_names(files: list[FileCtx]) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# span-name
+# --------------------------------------------------------------------------
+
+# call sites that name a span/trace series: <module>.<fn> where the fn is
+# a recorder entry point — matched by the LAST TWO dotted components so
+# both `profiling.span(...)` and an aliased `_trace.instant(...)` resolve
+_SPAN_FNS = {"span": ("profiling", "trace", "_trace"),
+             "instant": ("trace", "_trace"),
+             "record": ("trace", "_trace")}
+# the declaring/implementing modules are exempt (they manipulate names)
+_SPAN_EXEMPT_FILES = {_METRIC_REGISTRY_FILE, "profiling.py",
+                      "observe/trace.py"}
+
+
+def _span_registry(files: list[FileCtx]) -> tuple[set[str], list[Finding]]:
+    """Names declared in metric_names.SPANS (+ duplicate findings); falls
+    back to the live registry when the scanned tree has no copy (fixture
+    runs)."""
+    for ctx in files:
+        if ctx.relpath == _METRIC_REGISTRY_FILE:
+            names: set[str] = set()
+            dupes: list[Finding] = []
+            for node in ctx.tree.body:
+                # SPANS = {...} plain or annotated (SPANS: dict[...] = {...})
+                target = (node.targets[0] if isinstance(node, ast.Assign)
+                          and len(node.targets) == 1
+                          else node.target if isinstance(node, ast.AnnAssign)
+                          else None)
+                if not (isinstance(target, ast.Name)
+                        and target.id == "SPANS"
+                        and isinstance(getattr(node, "value", None),
+                                       ast.Dict)):
+                    continue
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str):
+                        if k.value in names:
+                            dupes.append(ctx.finding(
+                                "span-name", k,
+                                f"span {k.value!r} declared more than "
+                                f"once in the SPANS registry"))
+                        names.add(k.value)
+            return names, dupes
+    try:
+        from ..observe import metric_names as _mn
+
+        return set(_mn.declared_spans()), []
+    except Exception:
+        return set(), []
+
+
+def check_span_names(files: list[FileCtx]) -> list[Finding]:
+    declared, out = _span_registry(files)
+    for ctx in files:
+        if ctx.relpath in _SPAN_EXEMPT_FILES:
+            continue
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            d = dotted(node.func)
+            if not d:
+                continue
+            parts = d.split(".")
+            if len(parts) < 2 or parts[-1] not in _SPAN_FNS \
+                    or parts[-2] not in _SPAN_FNS[parts[-1]]:
+                continue
+            # trace.record's name is the SECOND positional (after ph)
+            arg = node.args[1 if parts[-1] == "record"
+                            and len(node.args) > 1 else 0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                out.append(ctx.finding(
+                    "span-name", node,
+                    "dynamic span name — span/trace names must be "
+                    "literals declared in observe/metric_names.py SPANS; "
+                    "put dynamic identity (device, item, bytes) in the "
+                    "attribution kwargs"))
+            elif arg.value not in declared:
+                out.append(ctx.finding(
+                    "span-name", node,
+                    f"span name {arg.value!r} is not declared in "
+                    f"observe/metric_names.py SPANS — a typo'd span "
+                    f"silently forks the timeline and the aggregates"))
+    return out
+
+
 ALL_CHECKS = {
     "host-sync": check_host_sync,
     "lock-discipline": check_lock_discipline,
     "config-registry": check_config_registry,
     "metric-name": check_metric_names,
+    "span-name": check_span_names,
 }

@@ -1,0 +1,333 @@
+"""``bst trace-report``: the questions span AGGREGATES cannot answer.
+
+``profiling`` can say `fusion.d2h` totalled 13.8 s; only the timeline can
+say whether those seconds hid under `fusion.write`, how long each device
+sat idle between dispatches, and which per-block causal chain
+(dispatch → kernel → d2h → write) ended the run. This module turns a
+flight-recorder trace (``observe/trace.py`` Perfetto JSON, single file or
+the ``telemetry-merge`` fold of a pod run) into exactly those numbers:
+
+- per-stage wall-clock decomposed into **compute / d2h / write / idle**
+  (union time per category, so N overlapping writes count once);
+- **pairwise overlap** seconds + percentages between the categories —
+  the direct measurement of "does D2H overlap the writes", the 0.64×
+  frontier question (ROADMAP "Known gap");
+- per-track (device / writer thread) busy/idle and the largest idle
+  gaps — the scheduler-shaped holes items 2–3 must fill;
+- the **critical path**: per-item causal chains reassembled from the
+  events' work-item identity, the chain that finishes last, and its
+  top-k blocking segments by duration.
+
+Everything here is pure computation over the parsed JSON — the CLI shim
+lives in ``cli/telemetry_tools.py``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+
+def _category(name: str) -> str:
+    if name.endswith(".d2h"):
+        return "d2h"
+    if name.endswith(".write"):
+        return "write"
+    if name.endswith(".kernel") or name.endswith(".kernel_sync") \
+            or name.endswith(".dispatch"):
+        return "compute"
+    if name.endswith(".prefetch") or name.endswith(".extract"):
+        return "read"
+    if name.endswith(".h2d_tiles"):
+        return "h2d"
+    return "other"
+
+
+def _group(name: str, args: dict) -> str:
+    """Report group for one interval: the span-name prefix, except the
+    generic layers (mesh loop, retry wrapper, pair scheduler) which
+    borrow their stage label's first token — ``mesh.d2h`` inside a
+    ``"fusion batch …"`` stage belongs to the fusion story."""
+    head = name.split(".")[0]
+    if head in ("mesh", "retry", "pair", "barrier"):
+        stage = str(args.get("stage") or "")
+        tok = stage.split(" ")[0].split(".")[0].split("-")[0]
+        return tok or head
+    return head
+
+
+def load_events(path: str) -> tuple[list[dict], dict]:
+    """Flat event list + metadata from a trace file, a telemetry dir
+    (preferring ``merged-trace.json``, else every ``trace-*.json``), or a
+    merged trace."""
+    paths: list[str]
+    if os.path.isdir(path):
+        merged = os.path.join(path, "merged-trace.json")
+        per_proc = sorted(glob.glob(os.path.join(path, "trace-*-of-*.json")))
+        # a merged fold is preferred — unless a per-process trace is NEWER
+        # (the dir was reused for another run after the last telemetry-merge),
+        # in which case the stale merge would silently report the old run
+        if os.path.exists(merged) and not any(
+                os.path.getmtime(p) > os.path.getmtime(merged)
+                for p in per_proc):
+            paths = [merged]
+        else:
+            paths = per_proc
+        if not paths:
+            raise FileNotFoundError(
+                f"no merged-trace.json or trace-*.json under {path}")
+    else:
+        paths = [path]
+    events: list[dict] = []
+    meta: dict = {"files": [os.path.basename(p) for p in paths],
+                  "recorded": 0, "dropped": 0,
+                  "unaligned_processes": []}
+    for p in paths:
+        with open(p, encoding="utf-8") as f:
+            doc = json.load(f)
+        b = doc.get("bst", {})
+        meta["recorded"] += int(b.get("recorded") or 0)
+        meta["dropped"] += int(b.get("dropped") or 0)
+        meta["unaligned_processes"] += b.get("unaligned_processes") or []
+        events.extend(doc.get("traceEvents", ()))
+    # concatenating several PER-PROCESS traces puts unaligned host clocks
+    # on one timeline — every cross-process number (overlap, idle,
+    # critical path) is then skewed; the CLI warns and points at
+    # telemetry-merge, which barrier-aligns the clocks first
+    meta["unmerged"] = len(paths) > 1
+    return events, meta
+
+
+def build_intervals(events: list[dict]) -> tuple[list[dict], dict]:
+    """Pair B/E events into intervals (seconds); returns (intervals,
+    track_names). Pairing is a per-(pid, tid, name) LIFO stack — Chrome
+    ``B``/``E`` semantics; unmatched begins (ring overflow tore their
+    end off) are dropped rather than invented."""
+    stacks: dict[tuple, list] = {}
+    track_names: dict[tuple, str] = {}
+    out: list[dict] = []
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "M":
+            if ev.get("name") == "thread_name":
+                track_names[(ev.get("pid", 0), ev.get("tid", 0))] = \
+                    (ev.get("args") or {}).get("name", "")
+            continue
+        if ph not in ("B", "E", "X"):
+            continue
+        key = (ev.get("pid", 0), ev.get("tid", 0), ev.get("name"))
+        ts = float(ev.get("ts", 0.0)) / 1e6
+        if ph == "X":
+            out.append({"name": ev.get("name"), "start": ts,
+                        "end": ts + float(ev.get("dur", 0.0)) / 1e6,
+                        "pid": key[0], "tid": key[1],
+                        "args": ev.get("args") or {}})
+        elif ph == "B":
+            stacks.setdefault(key, []).append((ts, ev.get("args") or {}))
+        else:
+            stack = stacks.get(key)
+            if stack:
+                t0, args = stack.pop()
+                if ts < t0:
+                    continue   # wall clock stepped backwards (NTP/suspend)
+                               # mid-span: drop rather than go negative
+                merged = {**args, **(ev.get("args") or {})}
+                out.append({"name": key[2], "start": t0, "end": ts,
+                            "pid": key[0], "tid": key[1], "args": merged})
+    out.sort(key=lambda iv: (iv["start"], iv["end"]))
+    return out, track_names
+
+
+def _union(ivs: list[dict]) -> list[tuple[float, float]]:
+    if not ivs:
+        return []
+    spans = sorted((iv["start"], iv["end"]) for iv in ivs)
+    merged = [list(spans[0])]
+    for s, e in spans[1:]:
+        if s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    return [(s, e) for s, e in merged]
+
+
+def _total(union: list[tuple[float, float]]) -> float:
+    return sum(e - s for s, e in union)
+
+
+def _intersect(a: list[tuple[float, float]],
+               b: list[tuple[float, float]]) -> float:
+    i = j = 0
+    out = 0.0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            out += e - s
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def _pct(x: float, denom: float) -> float:
+    return round(100.0 * x / denom, 1) if denom > 0 else 0.0
+
+
+def build_report(events: list[dict], meta: dict | None = None,
+                 top: int = 5) -> dict:
+    intervals, track_names = build_intervals(events)
+    rep: dict = {"events": len([e for e in events
+                                if e.get("ph") in ("B", "E", "X", "i")]),
+                 "intervals": len(intervals),
+                 "recorded": (meta or {}).get("recorded", 0),
+                 "dropped": (meta or {}).get("dropped", 0),
+                 "stages": {}, "tracks": [],
+                 "critical_path": None, "top_blocking": []}
+    if not intervals:
+        return rep
+    t0 = min(iv["start"] for iv in intervals)
+    t1 = max(iv["end"] for iv in intervals)
+    rep["wall_s"] = round(t1 - t0, 6)
+
+    # -- per-stage category decomposition + pairwise overlap ---------------
+    by_group: dict[str, list[dict]] = {}
+    for iv in intervals:
+        by_group.setdefault(_group(iv["name"], iv["args"]), []).append(iv)
+    for group, ivs in sorted(by_group.items()):
+        g0 = min(iv["start"] for iv in ivs)
+        g1 = max(iv["end"] for iv in ivs)
+        wall = g1 - g0
+        unions = {}
+        for cat in ("compute", "d2h", "write", "read", "h2d", "other"):
+            unions[cat] = _union([iv for iv in ivs
+                                  if _category(iv["name"]) == cat])
+        busy = _union(ivs)
+        entry = {
+            "wall_s": round(wall, 6),
+            "idle_s": round(max(0.0, wall - _total(busy)), 6),
+            "idle_pct": _pct(max(0.0, wall - _total(busy)), wall),
+            "overlap": {},
+        }
+        for cat in ("compute", "d2h", "write", "read", "h2d"):
+            tot = _total(unions[cat])
+            if tot:
+                entry[f"{cat}_s"] = round(tot, 6)
+                entry[f"{cat}_pct"] = _pct(tot, wall)
+        for a, b in (("d2h", "write"), ("compute", "d2h"),
+                     ("compute", "write")):
+            ta, tb = _total(unions[a]), _total(unions[b])
+            if ta and tb:
+                ov = _intersect(unions[a], unions[b])
+                entry["overlap"][f"{a}_{b}"] = {
+                    "seconds": round(ov, 6),
+                    f"pct_of_{a}": _pct(ov, ta),
+                    f"pct_of_{b}": _pct(ov, tb),
+                }
+        rep["stages"][group] = entry
+
+    # -- per-track (device / thread) busy, idle, largest gaps --------------
+    by_track: dict[tuple, list[dict]] = {}
+    for iv in intervals:
+        by_track.setdefault((iv["pid"], iv["tid"]), []).append(iv)
+    for (pid, tid), ivs in sorted(by_track.items()):
+        busy = _union(ivs)
+        first, last = busy[0][0], busy[-1][1]
+        span = last - first
+        gaps = [(busy[i + 1][0] - busy[i][1], busy[i][1])
+                for i in range(len(busy) - 1)]
+        gaps.sort(reverse=True)
+        rep["tracks"].append({
+            "pid": pid, "tid": tid,
+            "name": track_names.get((pid, tid)) or f"tid {tid}",
+            "busy_s": round(_total(busy), 6),
+            "span_s": round(span, 6),
+            "util_pct": _pct(_total(busy), span),
+            "largest_gaps": [{"seconds": round(g, 6),
+                              "at_s": round(at - t0, 6)}
+                             for g, at in gaps[:3] if g > 0],
+        })
+
+    # -- critical path over per-item causal chains -------------------------
+    chains: dict[tuple, list[dict]] = {}
+    for iv in intervals:
+        item = iv["args"].get("item")
+        if item is None or iv["name"] == "retry.attempt":
+            continue   # the attempt wrapper CONTAINS the chain segments
+        key = (_group(iv["name"], iv["args"]), json.dumps(item))
+        chains.setdefault(key, []).append(iv)
+    if chains:
+        crit_key = max(chains, key=lambda k: max(iv["end"]
+                                                 for iv in chains[k]))
+        segs = sorted(chains[crit_key], key=lambda iv: iv["start"])
+        path = []
+        prev_end = None
+        for iv in segs:
+            if prev_end is not None and iv["start"] - prev_end > 1e-6:
+                path.append({"name": "(wait)", "start_s":
+                             round(prev_end - t0, 6),
+                             "seconds": round(iv["start"] - prev_end, 6)})
+            path.append({"name": iv["name"],
+                         "start_s": round(iv["start"] - t0, 6),
+                         "seconds": round(iv["end"] - iv["start"], 6)})
+            prev_end = iv["end"] if prev_end is None \
+                else max(prev_end, iv["end"])
+        rep["critical_path"] = {
+            "stage": crit_key[0],
+            "item": json.loads(crit_key[1]),
+            "total_s": round(max(iv["end"] for iv in segs)
+                             - segs[0]["start"], 6),
+            "ends_at_s": round(max(iv["end"] for iv in segs) - t0, 6),
+            "segments": path,
+        }
+        rep["top_blocking"] = sorted(
+            path, key=lambda s: -s["seconds"])[:max(1, top)]
+    return rep
+
+
+def render_report(rep: dict) -> str:
+    lines = []
+    lines.append(
+        f"trace: {rep.get('wall_s', 0.0):.3f}s wall, "
+        f"{rep['intervals']} interval(s) from {rep['events']} event(s)"
+        + (f", {rep['dropped']} DROPPED by ring overflow"
+           if rep.get("dropped") else ""))
+    for group, e in rep["stages"].items():
+        parts = []
+        for cat, label in (("compute", "compute"), ("d2h", "d2h"),
+                           ("write", "write"), ("read", "read"),
+                           ("h2d", "h2d")):
+            if f"{cat}_s" in e:
+                parts.append(f"{label} {e[f'{cat}_s']:.3f}s "
+                             f"({e[f'{cat}_pct']:.0f}%)")
+        parts.append(f"idle {e['idle_s']:.3f}s ({e['idle_pct']:.0f}%)")
+        lines.append(f"[{group}] wall {e['wall_s']:.3f}s: "
+                     + " | ".join(parts))
+        for pair, ov in e["overlap"].items():
+            a, b = pair.split("_", 1)
+            pa = ov.get(f"pct_of_{a}", 0.0)
+            pb = ov.get(f"pct_of_{b}", 0.0)
+            lines.append(f"  overlap {a}<->{b}: {ov['seconds']:.3f}s "
+                         f"({pa:.0f}% of {a}, {pb:.0f}% of {b})")
+    if rep["tracks"]:
+        lines.append("tracks:")
+        for t in rep["tracks"]:
+            gaps = ", ".join(f"{g['seconds']:.3f}s @{g['at_s']:.3f}s"
+                             for g in t["largest_gaps"]) or "none"
+            lines.append(f"  p{t['pid']} {t['name']}: busy {t['busy_s']:.3f}s"
+                         f" ({t['util_pct']:.0f}% of its {t['span_s']:.3f}s"
+                         f" span), largest gaps: {gaps}")
+    cp = rep.get("critical_path")
+    if cp:
+        lines.append(f"critical path [{cp['stage']} item {cp['item']}]: "
+                     f"{cp['total_s']:.3f}s, ends at "
+                     f"+{cp['ends_at_s']:.3f}s")
+        lines.append("  " + " -> ".join(
+            f"{s['name']} {s['seconds']:.3f}s" for s in cp["segments"]))
+        lines.append("top blocking segments:")
+        for i, s in enumerate(rep["top_blocking"], 1):
+            lines.append(f"  {i}. {s['name']} {s['seconds']:.3f}s "
+                         f"(at +{s['start_s']:.3f}s)")
+    return "\n".join(lines)

@@ -29,17 +29,26 @@ def _register_telemetry_close(ctx):
         import sys
 
         from .. import observe, profiling
+        from ..observe import trace
 
         # during unwinding from a command error, the in-flight exception is
         # the active one — best-effort status for the manifest
         err = sys.exc_info()[1]
         report = (profiling.get().report()
                   if ctx.meta.get("bst.telemetry.profile") else None)
+        traced = trace.enabled()
         if observe.active():
+            # finalize archives the trace next to the manifest when on
             observe.finalize(
                 tool=ctx.info_name, params=ctx.params,
                 status="error" if err is not None else "ok",
                 error=repr(err) if err is not None else None)
+        if trace.enabled():   # --trace without --telemetry-dir
+            trace.finalize()
+        if traced and trace.last_path():
+            click.echo(f"[trace] {trace.last_path()} "
+                       f"(load in ui.perfetto.dev or run "
+                       f"'bst trace-report')", err=True)
         if report is not None:
             click.echo(f"[profile]\n{report}", err=True)
             profiling.enable(False)
@@ -66,6 +75,15 @@ def _set_profile(ctx, param, value):
     return value
 
 
+def _set_trace(ctx, param, value):
+    if value:
+        from ..observe import trace
+
+        trace.configure()
+        _register_telemetry_close(ctx)
+    return value
+
+
 def infrastructure_options(f):
     """--dryRun / --s3Region (AbstractInfrastructure.java:14-27) plus the
     shared observability switches every tool inherits: --telemetry-dir
@@ -86,6 +104,14 @@ def infrastructure_options(f):
                      expose_value=False, callback=_set_profile,
                      help="record per-span wall-clock aggregates and print "
                           "the span table on exit")(f)
+    f = click.option("--trace", is_flag=True, default=False,
+                     expose_value=False, callback=_set_trace,
+                     help="record a begin/end timeline of every span "
+                          "(flight recorder, BST_TRACE_BUFFER_BYTES ring) "
+                          "and write a Perfetto-loadable trace JSON on "
+                          "exit (next to --telemetry-dir files when set, "
+                          "else BST_TRACE_PATH / ./bst-trace.json); "
+                          "analyze with 'bst trace-report'")(f)
     return f
 
 

@@ -289,10 +289,15 @@ def _write_bdv_output_xml(xml_out: str, container: str, meta, storage_format) ->
               flag_value="",
               help="apply solved intensity coefficients (optionally give the "
                    "N5 path; default: intensity.n5 next to the input XML)")
+@click.option("--devices", "devices", type=int, default=None,
+              help="local devices to shard the block grid over (default: "
+                   "all; 1 selects the single-device composite/per-block "
+                   "paths — the control runs --trace attribution compares "
+                   "against)")
 def affine_fusion_cmd(output, storage_opt, fusion_type, block_scale, masks,
                       mask_offset, blending_range, blending_border,
                       channel_index, timepoint_index, prefetch, intensity_n5,
-                      dry_run, **kwargs):
+                      devices, dry_run, **kwargs):
     """Fuse all views into the prepared container (THE workload)."""
     t_start = time.time()
     store = open_container(output)
@@ -374,6 +379,7 @@ def affine_fusion_cmd(output, storage_opt, fusion_type, block_scale, masks,
                 mask_offset=moff,
                 zarr_ct=(ci, ti) if is_zarr5d else None,
                 coefficients=coefficients,
+                devices=devices,
                 io_threads=4 if prefetch else 1,
             )
             total_vox += stats.voxels

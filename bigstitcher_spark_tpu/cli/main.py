@@ -52,6 +52,7 @@ cli.add_command(utility_tools.map_setup_ids_cmd, "map-setup-ids")
 cli.add_command(utility_tools.env_cmd, "env")
 cli.add_command(utility_tools.serve_container_cmd, "serve-container")
 cli.add_command(telemetry_tools.telemetry_merge_cmd, "telemetry-merge")
+cli.add_command(telemetry_tools.trace_report_cmd, "trace-report")
 cli.add_command(analysis_tools.lint_cmd, "lint")
 cli.add_command(analysis_tools.config_cmd, "config")
 

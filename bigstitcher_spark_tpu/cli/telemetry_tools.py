@@ -1,11 +1,16 @@
-"""``telemetry-merge`` — fold a pod run's per-process telemetry files.
+"""``telemetry-merge`` / ``trace-report`` — fold and analyze run telemetry.
 
 No reference counterpart (Spark's history server renders the merged view
 of its event logs); here N per-process ``manifest-*.json`` /
 ``events-*.jsonl`` file sets written by ``--telemetry-dir`` fold into one
 ``merged-report.json`` plus a console summary: per-process status, the
 summed metric/byte totals, the merged span table and per-stage
-throughput.
+throughput. Flight-recorder traces (``--trace``) fold alongside —
+per-process ``trace-*.json`` files are clock-aligned via the shared
+barrier exits into ``merged-trace.json`` — and ``bst trace-report``
+computes what the aggregates cannot: compute/D2H/write/idle
+decomposition, pairwise overlap, per-device idle gaps and the
+per-block critical path (analysis/tracereport.py).
 """
 
 from __future__ import annotations
@@ -87,3 +92,71 @@ def telemetry_merge_cmd(telemetry_dir, output):
     if retries:
         click.echo(f"retry rounds: {int(retries)}")
     click.echo(f"merged report -> {out}")
+
+    # fold any per-process flight-recorder traces onto one barrier-aligned
+    # timeline so trace-report / Perfetto see the whole pod run at once
+    from ..observe.trace import merge_traces
+
+    try:
+        merged_trace = merge_traces(telemetry_dir)
+    except (json.JSONDecodeError, OSError) as e:
+        click.echo(f"trace merge skipped (corrupt/unreadable trace: {e})",
+                   err=True)
+        merged_trace = None
+    if merged_trace:
+        unaligned = merged_trace.bst.get("unaligned_processes")
+        if unaligned:
+            click.echo(f"WARNING: processes {unaligned} had no barrier "
+                       f"exits in common with process 0 — their clocks "
+                       f"are UNALIGNED in the merged trace", err=True)
+        click.echo(f"merged trace -> {merged_trace} "
+                   f"(analyze with 'bst trace-report', or load in "
+                   f"ui.perfetto.dev)")
+
+
+@click.command()
+@click.argument("path", type=click.Path(exists=True))
+@click.option("--top", "top", type=int, default=5, show_default=True,
+              help="how many blocking segments of the critical path to name")
+@click.option("--json", "as_json", is_flag=True,
+              help="machine-readable report")
+def trace_report_cmd(path, top, as_json):
+    """Analyze a --trace timeline: overlap, idle gaps, critical path.
+
+    PATH is a trace JSON file or a telemetry directory (prefers
+    merged-trace.json, else every trace-*.json in it). Prints each
+    stage's wall clock decomposed into compute/D2H/write/idle union
+    time, the pairwise overlap percentages between them (is D2H hiding
+    under the writes?), per-device/per-thread busy time and the largest
+    idle gaps, and the critical path over per-block causal chains
+    (dispatch -> kernel -> d2h -> write) with its top blocking segments.
+    """
+    from ..analysis.tracereport import (
+        build_report, load_events, render_report,
+    )
+
+    try:
+        events, meta = load_events(path)
+    except FileNotFoundError as e:
+        raise click.ClickException(str(e)) from e
+    except json.JSONDecodeError as e:
+        # traces are written at process exit — an OOM-kill mid-dump
+        # leaves a half-written file
+        raise click.ClickException(
+            f"corrupt trace JSON under {path}: {e}") from e
+    if meta.get("unmerged"):
+        click.echo(f"WARNING: analyzing {len(meta['files'])} per-process "
+                   f"traces on their RAW host clocks — run "
+                   f"'bst telemetry-merge' first to barrier-align them; "
+                   f"cross-process overlap/idle/critical-path numbers "
+                   f"below are skewed by any clock offset", err=True)
+    if meta.get("unaligned_processes"):
+        click.echo(f"WARNING: processes {meta['unaligned_processes']} had "
+                   f"no barrier exits in common with process 0 — their "
+                   f"clocks are unaligned in this trace", err=True)
+    report = build_report(events, meta, top=top)
+    if as_json:
+        click.echo(json.dumps(report, indent=1, default=str))
+    else:
+        click.echo(f"trace files: {', '.join(meta['files'])}")
+        click.echo(render_report(report))

@@ -129,6 +129,18 @@ _knob("BST_DISTRIBUTED", "bool", False,
 _knob("BST_TELEMETRY_DIR", "str", None,
       "Telemetry output directory for bench.py runs (CLI tools take "
       "--telemetry-dir instead).", consumer="bench")
+_knob("BST_TRACE", "bool", False,
+      "Enable the timeline flight recorder without the --trace CLI flag "
+      "(bench.py and scripted runs); the trace archives next to the run "
+      "manifest when telemetry is on.")
+_knob("BST_TRACE_BUFFER_BYTES", "bytes", 64 << 20,
+      "Byte budget of the --trace flight-recorder ring buffer "
+      "(observe/trace.py); overflow keeps the NEWEST events and counts "
+      "drops in bst_trace_events_dropped_total.")
+_knob("BST_TRACE_PATH", "str", None,
+      "Explicit output path for the --trace Perfetto JSON. Default: "
+      "trace-{process}.json in the telemetry dir when one is set, else "
+      "./bst-trace.json.")
 
 # -- install wrappers ------------------------------------------------------
 _knob("BST_DEVICES", "int", None,

@@ -192,7 +192,7 @@ def fuse_grid_block(
     if stats is not None:
         stats.compile_keys.add((bshape, pshape, vb, fusion_type,
                                 coefficients is not None))
-    with profiling.span("fusion.kernel"):
+    with profiling.span("fusion.kernel", item=tuple(map(int, block.offset))):
         fused, wsum = F.fuse_block(
             patches, affines, offsets, img_dims, borders, ranges, valid,
             block_shape=bshape, fusion_type=fusion_type, inside_offs=ioffs,
@@ -339,7 +339,7 @@ def _fuse_sep_path(sd, loader, plans, block, bshape, fusion_type, blend,
      ) = _sep_inputs(sd, loader, plans, pshape, vb, blend, inside_offset)
     if stats is not None:
         stats.compile_keys.add((bshape, pshape, "sep", vb, fusion_type))
-    with profiling.span("fusion.kernel"):
+    with profiling.span("fusion.kernel", item=tuple(map(int, block.offset))):
         fused, wsum = F.fuse_block_sep(
             patches, diags, ts, offsets, img_dims, borders, ranges, valid,
             block_shape=bshape, fusion_type=fusion_type, inside_offs=ioffs,
@@ -359,7 +359,7 @@ def _fuse_shift_path(loader, plans, block, block_global, bshape, fusion_type,
                        inside_offset)
     if stats is not None:
         stats.compile_keys.add((bshape, "shift", vb, fusion_type))
-    with profiling.span("fusion.kernel"):
+    with profiling.span("fusion.kernel", item=tuple(map(int, block.offset))):
         fused, wsum = F.fuse_block_shift(
             patches, fracs, lpos0, img_dims, borders, ranges, valid,
             block_shape=bshape, fusion_type=fusion_type, inside_offs=ioffs,
@@ -662,14 +662,15 @@ def _drain_device_volume(out, out_ds, zarr_ct, io_threads=4):
 
     def drain(item):
         x0, slab = item
-        with profiling.span("fusion.d2h"):
+        nb = int(slab.nbytes)   # known pre-fetch: device arrays size freely
+        with profiling.span("fusion.d2h", item=int(x0), nbytes=nb):
             data = np.asarray(slab)
             _D2H_BYTES.inc(data.nbytes)
             if data.dtype.kind in "iu" and data.dtype.itemsize < 4:
                 # output converted to storage dtype ON DEVICE: the wire
                 # carries uint16/uint8, not the kernel's float32
                 _D2H_SAVED.inc(data.size * 4 - data.nbytes)
-        with profiling.span("fusion.write"):
+        with profiling.span("fusion.write", item=int(x0), nbytes=nb):
             if zarr_ct is not None:
                 c, t = zarr_ct
                 out_ds.write(data[..., None, None], (x0, 0, 0, c, t))
@@ -680,7 +681,8 @@ def _drain_device_volume(out, out_ds, zarr_ct, io_threads=4):
         list(pool.map(drain, slabs))
 
 def _write_block(out_ds, data, block, zarr_ct):
-    with profiling.span("fusion.write"):
+    with profiling.span("fusion.write", item=tuple(map(int, block.offset)),
+                        nbytes=int(data.nbytes)):
         if zarr_ct is not None:
             c, t = zarr_ct
             out_ds.write(data[..., None, None], (*block.offset, c, t))
@@ -932,19 +934,24 @@ def fuse_volume(
             stats.skipped_empty += 1
             return
         fused, wsum = res
+        bkey = tuple(map(int, block.offset))
         if masks:
             out = (wsum > 0).astype(np.float32)
             if out_dtype != "float32":
                 out *= float(np.iinfo(np.dtype(out_dtype)).max)
             data = out.astype(out_dtype)
         else:
-            data = jax.device_get(
-                F.convert_intensity(
-                    fused, np.float32(min_intensity), np.float32(max_intensity),
-                    out_dtype=out_dtype,
+            out_nbytes = int(np.prod(block.size)
+                             * np.dtype(out_dtype).itemsize)
+            with profiling.span("fusion.d2h", item=bkey, nbytes=out_nbytes):
+                data = jax.device_get(
+                    F.convert_intensity(
+                        fused, np.float32(min_intensity),
+                        np.float32(max_intensity), out_dtype=out_dtype,
+                    )
                 )
-            )
-        with profiling.span("fusion.write"):
+        with profiling.span("fusion.write", item=bkey,
+                            nbytes=int(data.nbytes)):
             if zarr_ct is not None:
                 c, t = zarr_ct
                 out5 = data[..., None, None]

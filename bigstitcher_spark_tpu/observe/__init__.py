@@ -26,7 +26,7 @@ import os
 import sys
 import time
 
-from . import events, manifest, metrics, progress  # noqa: F401
+from . import events, manifest, metrics, progress, trace  # noqa: F401
 
 _STATE: dict = {
     "dir": None,
@@ -95,11 +95,21 @@ def finalize(tool: str | None = None, params: dict | None = None,
     with open(prom_path, "w", encoding="utf-8") as f:
         f.write(reg.render_prometheus())
     spans = {k: {"count": s.count, "total_s": round(s.total_s, 3),
-                 "max_s": round(s.max_s, 3)}
+                 "max_s": round(s.max_s, 3), "min_s": round(s.min_s, 3)}
              for k, s in profiling.get().stats().items()}
     seconds = time.time() - _STATE["started_at"]
     events.emit("run.end", status=status, seconds=round(seconds, 3),
                 error=error)
+    # archive the flight-recorder ring (if one is recording) next to the
+    # manifest, so a traced run's timeline travels with its telemetry —
+    # unless BST_TRACE_PATH/configure(path=) sent it elsewhere, in which
+    # case the manifest must point at the real location, not a dangling
+    # dir-local basename
+    trace_path = trace.finalize(dir_hint=d)
+    if trace_path is not None and \
+            os.path.dirname(os.path.abspath(trace_path)) == \
+            os.path.abspath(d):
+        trace_path = os.path.basename(trace_path)
     ev_path = events.close()
     path = manifest.write_manifest(
         d,
@@ -115,6 +125,7 @@ def finalize(tool: str | None = None, params: dict | None = None,
         metrics_delta=reg.snapshot_delta(_STATE["metrics_baseline"]),
         stages=progress.records(),
         events_file=os.path.basename(ev_path) if ev_path else None,
+        trace_file=trace_path,
     )
     progress.reset_records()
     if _STATE["enabled_profiling"]:

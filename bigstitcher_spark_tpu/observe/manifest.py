@@ -62,6 +62,7 @@ def write_manifest(
     metrics_delta: dict,
     stages: list[dict],
     events_file: str | None,
+    trace_file: str | None = None,
 ) -> str:
     pi, pc = world
     doc = {
@@ -80,6 +81,8 @@ def write_manifest(
         "stages": stages,
         "events_file": events_file,
     }
+    if trace_file:
+        doc["trace_file"] = trace_file
     if error:
         doc["error"] = error
     path = os.path.join(directory, manifest_name(pi, pc))
@@ -107,6 +110,8 @@ def _merge_spans(dst: dict, src: dict) -> None:
         d["count"] += s.get("count", 0)
         d["total_s"] = round(d["total_s"] + s.get("total_s", 0.0), 3)
         d["max_s"] = max(d["max_s"], s.get("max_s", 0.0))
+        if "min_s" in s:   # pre-min_s manifests merge without it
+            d["min_s"] = min(d.get("min_s", s["min_s"]), s["min_s"])
 
 
 def merge_run(directory: str) -> dict:

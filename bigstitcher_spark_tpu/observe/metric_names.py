@@ -61,8 +61,57 @@ METRICS: dict[str, str] = {
     "bst_pair_redispatch_total":
         "pair tasks re-dispatched after a device failure",
     "bst_pair_device_util_pct": "stage device-utilization percentage",
+    # timeline flight recorder (observe/trace.py)
+    "bst_trace_events_total": "trace events recorded into the ring buffer",
+    "bst_trace_events_dropped_total":
+        "trace events dropped by ring-buffer overflow (newest events win)",
+}
+
+# Every trace/profiling SPAN name, declared exactly once — the same
+# silent-drift argument as METRICS above: a typo'd span name would mint a
+# fresh timeline series the trace-report and the span aggregates both
+# miss. The ``span-name`` lint check (analysis/checks.py) enforces that
+# every literal passed to ``profiling.span`` / ``trace.span`` /
+# ``trace.instant`` appears here and bans dynamically constructed names;
+# dynamic identity (device ordinal, block offset, pair index, bytes)
+# belongs in the span's attribution kwargs, never in the name.
+SPANS: dict[str, str] = {
+    # affine fusion driver (models/affine_fusion.py)
+    "fusion.kernel": "fused XLA computation (dispatch + on-device compute)",
+    "fusion.prefetch": "host-side source-box prefetch for one view patch",
+    "fusion.h2d_tiles": "composite-path tile upload into HBM",
+    "fusion.d2h": "device-to-host fetch of fused output (slab or block)",
+    "fusion.write": "container write of fused output (slab or block)",
+    # detection / stitching / matching / nonrigid drivers
+    "detection.kernel": "DoG + localization device computation",
+    "stitching.extract": "overlap crop extraction for one pair batch",
+    "stitching.kernel": "phase-correlation device program",
+    "stitching.kernel_sync": "PCM device completion sync",
+    "stitching.refine": "host-side Pearson refinement of PCM peaks",
+    "nonrigid.kernel": "nonrigid fusion device computation",
+    "nonrigid.write": "nonrigid fused block write",
+    "nonrigid.prefetch": "nonrigid source patch prefetch",
+    "matching.group_pair": "descriptor matching for one view-group pair",
+    "matching.pair": "descriptor matching for one view pair",
+    # shared mesh work loop (parallel/mesh.py)
+    "mesh.d2h": "batched device_get of one sharded batch's outputs",
+    # pair-work scheduler (parallel/pairsched.py)
+    "pair.dispatch": "one pair task's device dispatch on its worker",
+    "pair.drain": "one segment's batched fetch + host post-processing",
+    "pair.redispatch": "pair task re-dispatched after a device failure",
+    # retry / IO / multihost (parallel/retry.py, io/chunkstore.py,
+    # parallel/distributed.py)
+    "retry.attempt": "one work item's processing attempt",
+    "block.fail": "a work item's attempt raised (instant)",
+    "io.read": "chunk-level container read (instant, bytes attributed)",
+    "io.write": "chunk-level container write (instant, bytes attributed)",
+    "barrier": "cross-host barrier wait (alignment anchor for merge)",
 }
 
 
 def declared() -> frozenset[str]:
     return frozenset(METRICS)
+
+
+def declared_spans() -> frozenset[str]:
+    return frozenset(SPANS)

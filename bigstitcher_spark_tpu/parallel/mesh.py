@@ -29,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import fusion as F
 from ..observe import metrics as _metrics
-from .. import config, observe
+from .. import config, observe, profiling
 
 BLOCK_AXIS = "blocks"
 
@@ -317,8 +317,13 @@ def run_sharded_batches(
         # fetch below only waits on THIS batch's buffers — a data
         # dependency)
         dispatch_ahead(bi)
+        # device-array nbytes are free to read pre-fetch: the span carries
+        # the batch's wire payload for the trace-report D2H decomposition
+        d2h_nbytes = sum(int(getattr(o, "nbytes", 0)) for o in outs)
         try:
-            outs = jax.device_get(list(outs))  # pipelined multi-output fetch
+            with profiling.span("mesh.d2h", stage=label, item=int(bi),
+                                nbytes=d2h_nbytes):
+                outs = jax.device_get(list(outs))  # pipelined batched fetch
         finally:
             # drained or dead, the buffers leave the ledger either way —
             # a fetch error must not shrink the window for the whole run

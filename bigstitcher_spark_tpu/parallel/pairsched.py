@@ -61,6 +61,7 @@ from typing import Any, Callable, Sequence
 
 from .. import config
 from ..observe import events, metrics as _metrics, progress as _progress
+from ..observe import trace as _trace
 from .retry import RetryError
 
 # placement treats zero-cost tasks as infinitesimally heavy so they still
@@ -177,7 +178,10 @@ def _run_queue(queue, di, dispatch, drain, window, results, failures,
         for t in queue:
             try:
                 t0 = time.perf_counter()
-                results[t.index] = (True, dispatch(t))
+                with _trace.span("pair.dispatch", device=di,
+                                 stage=meters.stage, item=t.index,
+                                 nbytes=t.nbytes or None):
+                    results[t.index] = (True, dispatch(t))
                 meters.add_busy(di, time.perf_counter() - t0)
                 meters.dispatch[di].inc()
                 hb.tick()
@@ -194,7 +198,9 @@ def _run_queue(queue, di, dispatch, drain, window, results, failures,
         tasks = [t for t, _ in group]
         try:
             t0 = time.perf_counter()
-            outs = drain(tasks, [h for _, h in group])
+            with _trace.span("pair.drain", device=di, stage=meters.stage,
+                             nbytes=sum(t.nbytes for t in tasks) or None):
+                outs = drain(tasks, [h for _, h in group])
             meters.add_busy(di, time.perf_counter() - t0)
             for t, r in zip(tasks, outs):
                 results[t.index] = (True, r)
@@ -223,7 +229,9 @@ def _run_queue(queue, di, dispatch, drain, window, results, failures,
             prev, seg, seg_bytes = seg, [], 0
         try:
             t0 = time.perf_counter()
-            out = dispatch(t)
+            with _trace.span("pair.dispatch", device=di, stage=meters.stage,
+                             item=t.index, nbytes=t.nbytes or None):
+                out = dispatch(t)
             meters.add_busy(di, time.perf_counter() - t0)
         except Exception as e:  # noqa: BLE001 - re-dispatched by caller
             failures.append((t, di, e))
@@ -358,6 +366,8 @@ def run_pair_tasks(
                 events.emit("pair.redispatch", stage=stage, task=t.index,
                             from_device=bad_di, to_device=di,
                             error=repr(err)[:200])
+                _trace.instant("pair.redispatch", device=di, stage=stage,
+                               item=t.index)
                 try:
                     with jax.default_device(devs[di]):
                         out = dispatch(t)

@@ -18,9 +18,22 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence, TypeVar
 
-from ..observe import events, metrics, progress
+from ..observe import events, metrics, progress, trace
 
 T = TypeVar("T")
+
+
+def _item_key(it):
+    """JSON-safe work-item identity for trace attribution: grid blocks
+    carry their offset (matching the fusion spans' item key), scalars pass
+    through, anything else stays anonymous."""
+    off = getattr(it, "offset", None)
+    if off is not None:
+        try:
+            return tuple(int(v) for v in off)
+        except (TypeError, ValueError):
+            return None
+    return it if isinstance(it, (int, str)) else None
 
 
 class RetryError(RuntimeError):
@@ -53,10 +66,13 @@ def run_with_retry(
 
         def attempt(it: T):
             try:
-                process(it)
+                with trace.span("retry.attempt", stage=label,
+                                item=_item_key(it)):
+                    process(it)
                 hb.tick()
                 return None
             except Exception as e:  # noqa: BLE001 - any task failure is retryable
+                trace.instant("block.fail", stage=label, item=_item_key(it))
                 return (it, e)
 
         if threads > 1:

@@ -2,8 +2,14 @@
 
 The reference only prints per-stage ``currentTimeMillis`` deltas
 (SparkAffineFusion.java:424,470,698); we keep per-span aggregates
-(count/total/max) queryable in-process and printable per stage.
+(count/total/min/max) queryable in-process and printable per stage.
 Zero overhead when disabled.
+
+``span`` is also the begin/end source for the timeline flight recorder
+(:mod:`.observe.trace`): when tracing is on, every span forwards its
+begin/end (plus optional device/stage/item/byte attribution) to the
+ring buffer under the SAME name, so the trace and the aggregates can
+never disagree about what was measured.
 """
 
 from __future__ import annotations
@@ -14,12 +20,15 @@ import time
 from collections import defaultdict
 from dataclasses import dataclass
 
+from .observe import trace as _trace
+
 
 @dataclass
 class SpanStat:
     count: int = 0
     total_s: float = 0.0
     max_s: float = 0.0
+    min_s: float = 0.0
 
 
 class Profiler:
@@ -35,23 +44,29 @@ class Profiler:
     def record(self, name: str, dt: float):
         with self._lock:
             s = self._stats[name]
+            s.min_s = dt if s.count == 0 else min(s.min_s, dt)
             s.count += 1
             s.total_s += dt
             s.max_s = max(s.max_s, dt)
 
     def stats(self) -> dict[str, SpanStat]:
         with self._lock:
-            return {k: SpanStat(v.count, v.total_s, v.max_s)
+            return {k: SpanStat(v.count, v.total_s, v.max_s, v.min_s)
                     for k, v in self._stats.items()}
 
     def report(self) -> str:
         # stats() snapshots under the lock — iterating self._stats directly
-        # here raced with concurrent record() calls mutating the dict
+        # here raced with concurrent record() calls mutating the dict.
+        # Sorted by total_s DESC so the hot span is the first line.
         stats = self.stats()
-        lines = ["span                            count    total_s      max_s"]
-        for k in sorted(stats):
+        lines = ["span                            count    total_s     "
+                 "mean_s      min_s      max_s"]
+        for k in sorted(stats, key=lambda k: (-stats[k].total_s, k)):
             s = stats[k]
-            lines.append(f"{k:<30} {s.count:>6} {s.total_s:>10.3f} {s.max_s:>10.3f}")
+            lines.append(
+                f"{k:<30} {s.count:>6} {s.total_s:>10.3f} "
+                f"{s.total_s / max(s.count, 1):>10.3f} "
+                f"{s.min_s:>10.3f} {s.max_s:>10.3f}")
         return "\n".join(lines)
 
 
@@ -67,15 +82,28 @@ def get() -> Profiler:
 
 
 @contextlib.contextmanager
-def span(name: str):
-    if not _global.enabled:
+def span(name: str, *, device: int | None = None, stage: str | None = None,
+         item=None, nbytes: int | None = None):
+    """Aggregate-profiled (and, when tracing, timeline-recorded) span.
+
+    The attribution kwargs cost nothing off the hot path: disabled, the
+    whole call is two truthiness checks and an immediate yield."""
+    tracing = _trace.enabled()
+    if not _global.enabled and not tracing:
         yield
         return
+    if tracing:
+        _trace.record("B", name, device=device, stage=stage, item=item,
+                      nbytes=nbytes)
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        _global.record(name, time.perf_counter() - t0)
+        if _global.enabled:
+            _global.record(name, time.perf_counter() - t0)
+        if tracing:
+            _trace.record("E", name, device=device, stage=stage, item=item,
+                          nbytes=nbytes)
 
 
 def device_sync(x):

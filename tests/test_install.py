@@ -29,7 +29,8 @@ def test_installer_covers_every_cli_tool(installed_bin):
     wrappers = set(os.listdir(installed_bin))
     # generic names install bst- prefixed (a bare `env`/`lint`/`config`
     # on PATH would shadow /usr/bin/env or unrelated same-named tools)
-    renamed = {"env": "bst-env", "lint": "bst-lint", "config": "bst-config"}
+    renamed = {"env": "bst-env", "lint": "bst-lint", "config": "bst-config",
+               "trace-report": "bst-trace-report"}
     expected = {renamed.get(t, t) for t in set(cli.commands)}
     missing = expected - wrappers
     assert not missing, f"installer missing wrappers for: {sorted(missing)}"
@@ -39,3 +40,9 @@ def test_wrapper_is_executable_and_targets_its_tool(installed_bin):
     w = installed_bin / "transform-points"
     assert os.access(w, os.X_OK)
     assert re.search(r"cli\.main transform-points", w.read_text())
+
+
+def test_trace_report_wrapper(installed_bin):
+    w = installed_bin / "bst-trace-report"
+    assert os.access(w, os.X_OK)
+    assert re.search(r"cli\.main trace-report", w.read_text())

@@ -296,6 +296,56 @@ class TestMetricNameCheck:
         assert len(fs) == 1 and "more than once" in fs[0].message
 
 
+class TestSpanNameCheck:
+    FILES = {
+        "observe/metric_names.py": """
+            SPANS = {
+                "fusion.kernel": "a declared span",
+            }
+            """,
+    }
+
+    def test_unregistered_and_dynamic(self, tmp_path):
+        _write_tree(tmp_path, {**self.FILES, "mod.py": """
+            from observe import trace as _trace
+            import profiling
+
+
+            def f(stage):
+                with profiling.span("fusion.kernel"):
+                    pass
+                with profiling.span("fusion.typo"):      # line 8
+                    pass
+                _trace.instant("stage." + stage)         # line 10: dynamic
+                _trace.record("B", "fusion.missing")     # line 11
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "span-name"]
+        assert sorted(f.line for f in fs) == [8, 10, 11]
+
+    def test_duplicate_declaration(self, tmp_path):
+        _write_tree(tmp_path, {"observe/metric_names.py": """
+            SPANS = {
+                "span.twice": "one",
+                "span.twice": "two",
+            }
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "span-name"]
+        assert len(fs) == 1 and "more than once" in fs[0].message
+
+    def test_declaring_modules_exempt(self, tmp_path):
+        # trace.py/profiling.py manipulate names as data; only CALL sites
+        # elsewhere are checked
+        _write_tree(tmp_path, {**self.FILES, "observe/trace.py": """
+            def span(name):
+                return record("B", name)
+            """, "profiling.py": """
+            def span(name, dynamic=str):
+                return dynamic(name)
+            """})
+        assert not [f for f in run_lint(tmp_path)
+                    if f.check == "span-name"]
+
+
 class TestSuppressionAndBaseline:
     def test_clean_fixture_zero_findings(self, tmp_path):
         _write_tree(tmp_path, {

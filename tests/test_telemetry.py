@@ -207,9 +207,14 @@ class TestProfilerReport:
         p = profiling.Profiler()
         p.record("stage.a", 0.5)
         p.record("stage.a", 1.5)
+        p.record("stage.hot", 5.0)
         rep = p.report()
         assert "stage.a" in rep
-        assert re.search(r"stage\.a\s+2\s+2\.000\s+1\.500", rep)
+        # count, total, mean, min, max
+        assert re.search(
+            r"stage\.a\s+2\s+2\.000\s+1\.000\s+0\.500\s+1\.500", rep)
+        # sorted by total_s DESC: the hot span is the FIRST data line
+        assert rep.splitlines()[1].startswith("stage.hot")
 
 
 class TestManifestMerge:
