@@ -476,7 +476,7 @@ def _io_snapshot(baseline):
             for k, v in delta.items()
             if k.startswith(("bst_io_", "bst_xfer_", "bst_chunk_cache_",
                              "bst_tile_cache_", "bst_inflight_",
-                             "bst_pair_", "bst_trace_"))
+                             "bst_pair_", "bst_trace_", "bst_epilogue_"))
             and isinstance(v, (int, float)) and v}
 
 
@@ -895,6 +895,131 @@ def measure_kernel_only(xml_path):
                  "first(compile)={:.2f}s".format(first)),
         "wire_d2h_mb_per_sec": round(host.nbytes / d2h_s / 1e6, 1),
         "wire_d2h_bytes": int(host.nbytes),
+    }
+
+
+# isotropic 2x chain: the pyramid adds 1/8 + 1/64 ~= 14% extra voxels/wire
+# bytes where the pre-epilogue flow re-read 100% of full res from disk
+FUSION_PYRAMID_STEPS = [[1, 1, 1], [2, 2, 2], [4, 4, 4]]
+
+
+def measure_fusion_pyramid(xml_path):
+    """Fusion with the fused multiscale epilogue: full res + the whole
+    downsample pyramid computed in HBM and shipped in ONE drain, vs the
+    baseline fusion+downsample sequence (reference-equivalent numpy
+    fusion, then numpy mean downsampling that re-reads the stored
+    full-res container — the exact flow the epilogue eliminates).
+
+    The headline ``value`` stays the FULL-RES-ONLY rate and the pyramid
+    voxels are reported separately (``vox_per_sec_incl_pyramid``), so the
+    epilogue can neither masquerade as a kernel regression (extra voxels
+    hidden in the same wall clock) nor inflate the kernel rate."""
+    import numpy as np
+
+    from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+    from bigstitcher_spark_tpu.io.container import (
+        create_fusion_container, read_container_meta)
+    from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+    from bigstitcher_spark_tpu.io.spimdata import SpimData
+    from bigstitcher_spark_tpu.models.affine_fusion import (
+        fuse_volume, pyramid_from_mr)
+    from bigstitcher_spark_tpu.models.downsample_driver import (
+        downsample_pyramid_level, read_padded)
+    from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+    sd = SpimData.load(xml_path)
+    loader = ViewLoader(sd)
+    views = sd.view_ids()
+    bbox = maximal_bounding_box(sd, views)
+    out = os.path.join(FIXTURE, "fused_pyramid.ome.zarr")
+
+    def make_container(path):
+        shutil.rmtree(path, ignore_errors=True)
+        create_fusion_container(
+            path, StorageFormat.ZARR, xml_path, 1, 1, bbox,
+            data_type="uint16", block_size=(128, 128, 64),
+            downsamplings=FUSION_PYRAMID_STEPS,
+            min_intensity=0.0, max_intensity=65535.0)
+        store = ChunkStore.open(path)
+        return store, read_container_meta(store).mr_infos[0]
+
+    def run():
+        store, mr = make_container(out)
+        ds = store.open_dataset(mr[0].dataset.strip("/"))
+        pyr = pyramid_from_mr(store, mr)
+        stats = fuse_volume(
+            sd, loader, views, ds, bbox, block_size=(128, 128, 64),
+            block_scale=(2, 2, 1), fusion_type="AVG_BLEND",
+            out_dtype="uint16", min_intensity=0.0, max_intensity=65535.0,
+            zarr_ct=(0, 0), pyramid=pyr)
+        # levels a (sharded) epilogue could not align fall back to the
+        # container-reread driver, exactly like the CLI
+        for lvl in range(1 + stats.pyramid_levels, len(mr)):
+            downsample_pyramid_level(store, mr[lvl - 1], mr[lvl], True,
+                                     (0, 0))
+        return store, mr, stats
+
+    run()  # warm compiles
+    # best-of-5, the primary metric's convention: shared-host IO weather
+    # swings the write-bound runs ~30% window to window
+    dt, (store, mr, stats), spans, io = _best_timed(5, run)
+    vox = int(np.prod(bbox.shape))
+    pyr_vox = sum(int(np.prod([int(v) for v in m.dimensions[:3]]))
+                  for m in mr[1:])
+
+    # baseline downsample leg: re-read the stored full-res container,
+    # numpy reshape-mean each level, round/clip, write — measured on a
+    # scratch container seeded (untimed) with the fused s0
+    bstore, bmr = make_container(os.path.join(FIXTURE,
+                                              "baseline_pyramid.ome.zarr"))
+    s0 = store.open_dataset(mr[0].dataset.strip("/")).read_full()
+    prev_ds = bstore.open_dataset(bmr[0].dataset.strip("/"))
+    prev_ds.write(s0, (0,) * 5)
+    t0 = time.time()
+    for lvl in range(1, len(bmr)):
+        rel = [int(v) for v in bmr[lvl].relativeDownsampling[:3]]
+        dims = [int(v) for v in bmr[lvl].dimensions[:3]]
+
+        def read3d(off, size, _p=prev_ds):
+            return _p.read((*off, 0, 0), (*size, 1, 1))[..., 0, 0]
+
+        needed = [d * f for d, f in zip(dims, rel)]
+        x = read_padded(read3d, prev_ds.shape[:3], (0, 0, 0),
+                        needed).astype(np.float32)
+        for ax, f in enumerate(rel):
+            if int(f) == 1:
+                continue
+            shp = list(x.shape)
+            shp[ax] //= int(f)
+            shp.insert(ax + 1, int(f))
+            x = x.reshape(shp).mean(axis=ax + 1)
+        ds_l = bstore.open_dataset(bmr[lvl].dataset.strip("/"))
+        ds_l.write(np.clip(np.round(x), 0, 65535).astype(np.uint16)
+                   [..., None, None], (0,) * 5)
+        prev_ds = ds_l
+    base_ds_s = time.time() - t0
+    if "fusion" not in _RUN_BASELINES:
+        _RUN_BASELINES["fusion"] = measure_baseline(xml_path)
+    base_fusion_s = vox / _RUN_BASELINES["fusion"]
+    base_total_s = base_fusion_s + base_ds_s
+    return {
+        "metric": "affine_fusion_pyramid_vox_per_sec",
+        "value": round(vox / dt, 1),
+        "unit": "voxel/s",
+        "note": ("fusion + full multiscale pyramid in one device drain; "
+                 "value is the FULL-RES-ONLY rate, pyramid voxels "
+                 "reported separately"),
+        "epilogue_levels": stats.pyramid_levels,
+        "pyramid_voxels": pyr_vox,
+        "vox_per_sec_incl_pyramid": round((vox + pyr_vox) / dt, 1),
+        "vs_baseline": round(base_total_s / dt, 3),
+        "baseline_seconds": {"fusion": round(base_fusion_s, 3),
+                             "downsample_reread": round(base_ds_s, 3)},
+        "baseline_provenance": (
+            "same-run numpy fusion rate + same-run numpy container-reread "
+            "downsample chain on this host"),
+        "spans": spans,
+        "io": io,
     }
 
 
@@ -1405,6 +1530,27 @@ def _finalize(result, truncated=None):
         tp = trace.finalize(dir_hint=_cfg.get_str("BST_TELEMETRY_DIR"))
         if tp:
             _log(f"trace -> {tp}")
+            # archive the rendered trace-report beside the trace/manifest
+            # and lift the d2h<->write overlap into the artifact's io
+            # columns — the 0.64x question answered by artifacts, not
+            # console captures
+            from bigstitcher_spark_tpu.analysis import tracereport
+
+            evs, tmeta = tracereport.load_events(tp)
+            rep = tracereport.build_report(evs, tmeta)
+            rpt = os.path.join(os.path.dirname(tp), "trace-report.txt")
+            with open(rpt, "w", encoding="utf-8") as f:
+                f.write(tracereport.render_report(rep) + "\n")
+            _log(f"trace report -> {rpt}")
+            ov = (rep.get("stages", {}).get("fusion", {})
+                  .get("overlap", {}).get("d2h_write"))
+            if ov:
+                io_cols = result.setdefault("io", {})
+                io_cols["trace_d2h_write_overlap_s"] = ov.get("seconds")
+                io_cols["trace_d2h_write_overlap_pct_of_d2h"] = \
+                    ov.get("pct_of_d2h")
+                io_cols["trace_d2h_write_overlap_pct_of_write"] = \
+                    ov.get("pct_of_write")
     except Exception as e:  # telemetry must never void the artifact
         _log(f"telemetry finalize failed: {e!r}")
     drift = _baseline_drift_flags()
@@ -1419,6 +1565,7 @@ def _finalize(result, truncated=None):
 # the extras pipeline: salvage reporting derives its denominator from this
 EXTRA_MEASURES = (
     ("kernel", lambda xml: measure_kernel_only(xml)),
+    ("fusion_pyramid", lambda xml: measure_fusion_pyramid(xml)),
     ("phasecorr", lambda xml: measure_phasecorr(xml)),
     ("phasecorr_kernel", lambda xml: measure_phasecorr_kernel(xml)),
     ("dog", lambda xml: measure_dog(xml)),
@@ -1447,6 +1594,7 @@ def child_main():
     _log("fixture ready")
     out = os.path.join(FIXTURE, "fused.ome.zarr")
     baseline = measure_baseline(xml)
+    _RUN_BASELINES["fusion"] = baseline  # reused by measure_fusion_pyramid
     _log(f"baseline {baseline:.0f} vox/s")
     from bigstitcher_spark_tpu import profiling
 

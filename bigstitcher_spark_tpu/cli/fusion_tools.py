@@ -294,10 +294,16 @@ def _write_bdv_output_xml(xml_out: str, container: str, meta, storage_format) ->
                    "all; 1 selects the single-device composite/per-block "
                    "paths — the control runs --trace attribution compares "
                    "against)")
+@click.option("--pyramid/--no-pyramid", "pyramid_epilogue", default=False,
+              help="materialize the container's downsample pyramid as a "
+                   "fused kernel epilogue while the data is device-"
+                   "resident, shipped in the same drain (bit-identical to "
+                   "the downsample stage, which then skips those levels "
+                   "instead of re-reading the full-res container)")
 def affine_fusion_cmd(output, storage_opt, fusion_type, block_scale, masks,
                       mask_offset, blending_range, blending_border,
                       channel_index, timepoint_index, prefetch, intensity_n5,
-                      devices, dry_run, **kwargs):
+                      devices, pyramid_epilogue, dry_run, **kwargs):
     """Fuse all views into the prepared container (THE workload)."""
     t_start = time.time()
     store = open_container(output)
@@ -366,6 +372,11 @@ def affine_fusion_cmd(output, storage_opt, fusion_type, block_scale, masks,
                        f"-> {mr[0].dataset}")
             if dry_run:
                 continue
+            pyr = None
+            if pyramid_epilogue and len(mr) > 1:
+                from ..models.affine_fusion import pyramid_from_mr
+
+                pyr = pyramid_from_mr(store, mr)
             stats = fuse_volume(
                 sd, loader, views, ds, meta.bbox,
                 block_size=tuple(meta.block_size), block_scale=tuple(bscale),
@@ -381,27 +392,48 @@ def affine_fusion_cmd(output, storage_opt, fusion_type, block_scale, masks,
                 coefficients=coefficients,
                 devices=devices,
                 io_threads=4 if prefetch else 1,
+                pyramid=pyr,
             )
             total_vox += stats.voxels
             click.echo(f"  {stats.voxels} voxels in {stats.seconds:.2f}s "
                        f"({stats.voxels / max(stats.seconds, 1e-9):,.0f} vox/s; "
                        f"{stats.skipped_empty} empty blocks skipped)")
+            if stats.pyramid_levels:
+                click.echo(
+                    f"  epilogue: {stats.pyramid_levels} pyramid level(s), "
+                    f"{stats.pyramid_voxels} voxels shipped in the fusion "
+                    "drain ("
+                    f"{(stats.voxels + stats.pyramid_voxels) / max(stats.seconds, 1e-9):,.0f}"
+                    " vox/s incl. pyramid)")
             if len(mr) > 1 and not dry_run:
-                _write_pyramid(store, mr, is_zarr5d, (ci, ti))
+                _write_pyramid(store, mr, is_zarr5d, (ci, ti),
+                               epilogue_levels=stats.pyramid_levels)
     click.echo(f"done, {total_vox} voxels, took {time.time() - t_start:.1f}s")
 
 
-def _write_pyramid(store, mr_levels, is_zarr5d, ct):
+def _write_pyramid(store, mr_levels, is_zarr5d, ct, epilogue_levels=0):
     """Downsample s0 into the remaining pyramid levels
     (SparkAffineFusion.java:703-782). Each level reads chunks the previous
-    stage may have written on another host -> barrier per boundary."""
-    from ..models.downsample_driver import downsample_pyramid_level
-    from ..parallel.distributed import barrier
+    stage may have written on another host -> barrier per boundary.
 
+    ``epilogue_levels``: how many leading levels the fusion drivers already
+    materialized as a fused multiscale epilogue this run. Their container
+    markers are set (and stale ones from earlier runs revoked) before the
+    barrier, then ``downsample_pyramid_level(skip_existing=True)`` skips
+    exactly those — no full-res container re-read for levels that rode the
+    fusion drain."""
+    from ..io.container import set_epilogue_written
+    from ..models.downsample_driver import downsample_pyramid_level
+    from ..parallel.distributed import barrier, world
+
+    if world()[0] == 0:  # one writer for the shared container attributes
+        for lvl in range(1, len(mr_levels)):
+            set_epilogue_written(store, mr_levels[lvl].dataset, ct,
+                                 lvl <= epilogue_levels)
     barrier("fusion-s0")
     for lvl in range(1, len(mr_levels)):
         downsample_pyramid_level(store, mr_levels[lvl - 1], mr_levels[lvl],
-                                 is_zarr5d, ct)
+                                 is_zarr5d, ct, skip_existing=True)
         barrier(f"fusion-s{lvl}")
 
 

@@ -135,8 +135,13 @@ def resave_cmd(xml, xml_out, out_path, as_n5, block_size, block_scale,
               help="container storage format (validated against the path)")
 @click.option("--blockScale", "block_scale", default="1,1,1")
 @click.option("--threads", type=int, default=8)
+@click.option("--skip-existing", "skip_existing", is_flag=True, default=False,
+              help="skip steps whose output dataset already exists with "
+                   "matching dimensions and downsampling factors (e.g. "
+                   "levels a fusion --pyramid epilogue materialized)")
 def downsample_cmd(path_in, dataset_in, datasets_out, downsampling,
-                   storage_opt, block_scale, threads, dry_run):
+                   storage_opt, block_scale, threads, skip_existing,
+                   dry_run):
     """Chained 2x downsampling of an existing dataset (pyramid levels)."""
     if storage_opt is not None:
         fmt = ChunkStore.open(path_in).format
@@ -185,6 +190,15 @@ def downsample_cmd(path_in, dataset_in, datasets_out, downsampling,
         abs_factor = [a * f for a, f in zip(abs_factor, step)]
         abs_factors.append(list(abs_factor))
         dims = [max(1, s // f) for s, f in zip(prev.shape, step)]
+        if skip_existing and store.is_dataset(out_path):
+            ex = store.open_dataset(out_path)
+            exf = store.get_attribute(out_path, "downsamplingFactors")
+            if (list(ex.shape) == dims and exf is not None
+                    and [int(v) for v in exf] == [int(v) for v in abs_factor]):
+                click.echo(f"  {out_path} {tuple(dims)} already exists with "
+                           "matching factors, skipped")
+                prev = ex
+                continue
         dst = store.create_dataset(out_path, dims, prev.block_size,
                                    prev.dtype.name, delete_existing=True)
         store.set_attribute(out_path, "downsamplingFactors",
@@ -202,7 +216,9 @@ def downsample_cmd(path_in, dataset_in, datasets_out, downsampling,
 
         run_sharded_downsample(grid, read_job, write_job, tuple(step),
                                io_threads=threads,
-                               label=f"downsample block ({out_path})")
+                               label=f"downsample block ({out_path})",
+                               device_drain=store.format
+                               != StorageFormat.HDF5)
         click.echo(f"  wrote {out_path} {tuple(dims)}")
         prev = dst
 

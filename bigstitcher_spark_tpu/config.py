@@ -74,6 +74,11 @@ _knob("BST_CHUNK_CACHE_BYTES", "bytes", 1 << 30,
 _knob("BST_TILE_CACHE_BYTES", "bytes", int(2e9),
       "Byte budget of the HBM-resident composite fusion tile cache keyed "
       "by dataset signature + write generation; 0 disables.")
+_knob("BST_WRITE_THREADS", "int", 8,
+      "Concurrent writer threads for the pipelined device-volume drain "
+      "(fusion full-res + epilogue pyramid slabs). ~8 MB slabs over ~8 "
+      "streams measured best on the wire-limited link; h5py containers "
+      "always clamp to 1 (single-writer rule).")
 _knob("BST_S3_REGION", "str", None,
       "Default AWS region for s3:// roots (the reference's --s3Region); "
       "io.uris.set_s3_region() overrides at runtime.")

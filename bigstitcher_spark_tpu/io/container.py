@@ -28,6 +28,33 @@ from .chunkstore import ChunkStore, StorageFormat
 ATTR_PREFIX = "Bigstitcher-Spark"
 
 
+def _epilogue_attr_key(dataset: str, ct) -> str:
+    """Root-attribute key recording that the fusion epilogue materialized
+    ``dataset`` for container slot (channel index, timepoint index). One
+    FLAT key per (level, slot) — dataset path separators are folded so the
+    key nests exactly one map under ``Bigstitcher-Spark/epilogue`` on
+    JSON-attribute stores and stays a single attribute name on HDF5."""
+    c, t = ct
+    return (f"{ATTR_PREFIX}/epilogue/"
+            f"{dataset.strip('/').replace('/', '.')}-c{c}t{t}")
+
+
+def set_epilogue_written(store, dataset: str, ct, written: bool) -> None:
+    """Record (or revoke) the fused-multiscale-epilogue marker for one
+    pyramid level dataset and (channel, timepoint) slot. The downsample
+    stage consults it (``downsample_pyramid_level(skip_existing=True)``)
+    to skip levels the fusion drain already shipped — revoking on every
+    non-epilogue fusion keeps a rerun from trusting stale levels."""
+    store.set_attribute("", _epilogue_attr_key(dataset, ct), bool(written))
+
+
+def epilogue_written(store, dataset: str, ct) -> bool:
+    """Whether the fusion epilogue materialized ``dataset`` for this
+    (channel, timepoint) slot."""
+    return bool(store.get_attribute("", _epilogue_attr_key(dataset, ct),
+                                    False))
+
+
 @dataclass
 class MultiResolutionLevelInfo:
     """Per-level dataset metadata (mvrecon ``MultiResolutionLevelInfo``)."""

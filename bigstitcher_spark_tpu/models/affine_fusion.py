@@ -41,6 +41,8 @@ _TILE_HITS = _metrics.counter("bst_tile_cache_hits_total")
 _TILE_MISSES = _metrics.counter("bst_tile_cache_misses_total")
 _TILE_HIT_BYTES = _metrics.counter("bst_tile_cache_hit_bytes_total")
 _TILE_EVICT_BYTES = _metrics.counter("bst_tile_cache_evict_bytes_total")
+_EPI_D2H_BYTES = _metrics.counter("bst_epilogue_d2h_bytes_total")
+_EPI_WRITE_BYTES = _metrics.counter("bst_epilogue_write_bytes_total")
 
 
 @dataclass
@@ -58,6 +60,39 @@ class FusionStats:
     skipped_empty: int = 0
     seconds: float = 0.0
     compile_keys: set = field(default_factory=set)
+    # multiscale-epilogue output, kept SEPARATE from ``voxels`` so
+    # full-res-only and pyramid-inclusive rates stay distinguishable
+    # (the epilogue must not masquerade as a kernel slowdown — or win)
+    pyramid_voxels: int = 0
+    pyramid_levels: int = 0
+
+
+@dataclass(frozen=True)
+class PyramidLevel:
+    """One downsample pyramid level the fusion drivers may materialize as a
+    kernel epilogue while the fused data is still device-resident
+    (ROADMAP item 3a), instead of the downsample stage re-reading the
+    full-res container. ``rel`` is the factor from the PREVIOUS level,
+    ``abs_factor`` from full resolution, ``dims`` the 3-D level extent —
+    all straight off the container's ``MultiResolutionLevelInfo``."""
+
+    ds: Dataset
+    rel: tuple[int, int, int]
+    abs_factor: tuple[int, int, int]
+    dims: tuple[int, int, int]
+
+
+def pyramid_from_mr(store, mr_levels) -> list["PyramidLevel"]:
+    """Epilogue spec for a container slot's ``MultiResolutionLevelInfo``
+    list (levels 1..n; level 0 is the fusion target itself) — the one
+    place the rel/abs/dims unpacking rules live, shared by the CLI
+    ``--pyramid`` path and the bench measure that validates it."""
+    return [PyramidLevel(
+        ds=store.open_dataset(m.dataset.strip("/")),
+        rel=tuple(int(v) for v in m.relativeDownsampling[:3]),
+        abs_factor=tuple(int(v) for v in m.absoluteDownsampling[:3]),
+        dims=tuple(int(v) for v in m.dimensions[:3]),
+    ) for m in mr_levels[1:]]
 
 
 def anisotropy_transform(factor: float) -> np.ndarray:
@@ -631,58 +666,117 @@ def _try_fuse_volume_device(
     return out
 
 
-def _drain_device_volume(out, out_ds, zarr_ct, io_threads=4):
-    """Pipelined D2H + write of a device-resident fused volume: slab along x
-    in storage-chunk multiples (each slab write touches its chunks exactly
-    once), start all transfers asynchronously, and let a thread pool overlap
-    the remaining transfers with compression + disk writes."""
+def _epilogue_pyramid_device(vol, pyramid, out_dtype):
+    """Chain the downsample pyramid ON DEVICE from the converted full-res
+    volume (the fused multiscale epilogue, ROADMAP item 3a): each level is
+    a strided float32 mean of the previous one, quantized back to the
+    storage dtype between steps — exactly what the container-reread path
+    sees when it reads the stored previous level, so levels are
+    bit-identical to ``downsample_pyramid_level`` output. Dispatch only
+    (the drain's D2H is the real sync). Returns [(PyramidLevel, device
+    array), ...]."""
+    from ..ops.downsample import downsample_level
+
+    levels = []
+    prev = vol
+    with profiling.span("fusion.epilogue.kernel"):
+        for lv in pyramid:
+            prev = downsample_level(prev, tuple(int(v) for v in lv.rel),
+                                    tuple(int(v) for v in lv.dims),
+                                    str(out_dtype))
+            levels.append((lv, prev))
+    return levels
+
+
+def _drain_device_volume(out, out_ds, zarr_ct, pyramid=(),
+                         out_dtype="float32"):
+    """Pipelined D2H + write of a device-resident fused volume and its
+    epilogue ``pyramid`` levels: slab every level along x in storage-chunk
+    multiples (each slab write touches its chunks exactly once), start all
+    transfers asynchronously, and let a thread pool overlap the remaining
+    transfers with compression + disk writes. Every fused voxel crosses
+    the wire exactly once; the pyramid rides the same drain instead of a
+    second read-modify-write pass over the container.
+
+    Dispatch order matters: the full-res slab transfers are primed FIRST,
+    then the epilogue levels are computed (they queue behind the slab
+    slices on the device stream) — s0 lands earliest and the pyramid
+    reductions overlap the full-res compression + writes instead of
+    stalling them. Returns the [(PyramidLevel, device array), ...] it
+    materialized."""
     from concurrent.futures import ThreadPoolExecutor
 
     from ..io.chunkstore import StorageFormat
 
     # ~8 MB slabs over ~8 streams measured best on the wire-limited link
-    io_threads = max(io_threads, 8)
+    # (the knob's default); --prefetch/io_threads does not reach this
+    # drain — BST_WRITE_THREADS is its one width control
+    io_threads = config.get_int("BST_WRITE_THREADS") or 1
     if getattr(out_ds.store, "format", None) == StorageFormat.HDF5:
         io_threads = 1  # h5py writers must not run concurrently
-    bs = out_ds.block_size
-    step = max(int(bs[0]), 1)
-    target = 8 << 20
-    row_bytes = int(np.prod(out.shape[1:])) * out.dtype.itemsize
-    if row_bytes * step < target:
-        step = int(np.ceil(target / max(row_bytes * step, 1))) * step
-    slabs = []
-    for x0 in range(0, out.shape[0], step):
-        x1 = min(x0 + step, out.shape[0])
-        slabs.append((x0, out[x0:x1]))
-    for _, s in slabs:
-        try:
-            s.copy_to_host_async()
-        except AttributeError:
-            pass
 
-    def drain(item):
-        x0, slab = item
+    def slab_plan(vol, ds):
+        bs = ds.block_size
+        step = max(int(bs[0]), 1)
+        target = 8 << 20
+        row_bytes = int(np.prod(vol.shape[1:])) * vol.dtype.itemsize
+        if row_bytes * step < target:
+            step = int(np.ceil(target / max(row_bytes * step, 1))) * step
+        return [(x0, vol[x0:min(x0 + step, vol.shape[0])])
+                for x0 in range(0, vol.shape[0], step)]
+
+    def prime(jobs):
+        for _, _, slab, _ in jobs:
+            try:
+                slab.copy_to_host_async()
+            except AttributeError:
+                pass
+
+    jobs = [(out_ds, x0, slab, False) for x0, slab in slab_plan(out, out_ds)]
+    prime(jobs)
+    levels = _epilogue_pyramid_device(out, pyramid, out_dtype)
+    for lv, lvol in levels:
+        lvl_jobs = [(lv.ds, x0, slab, True)
+                    for x0, slab in slab_plan(lvol, lv.ds)]
+        prime(lvl_jobs)
+        jobs += lvl_jobs
+
+    def drain(job):
+        ds, x0, slab, epi = job
         nb = int(slab.nbytes)   # known pre-fetch: device arrays size freely
-        with profiling.span("fusion.d2h", item=int(x0), nbytes=nb):
+        d2h_span = (profiling.span("fusion.epilogue.d2h", item=int(x0),
+                                   nbytes=nb) if epi else
+                    profiling.span("fusion.d2h", item=int(x0), nbytes=nb))
+        with d2h_span:
             data = np.asarray(slab)
             _D2H_BYTES.inc(data.nbytes)
+            if epi:
+                _EPI_D2H_BYTES.inc(data.nbytes)
             if data.dtype.kind in "iu" and data.dtype.itemsize < 4:
                 # output converted to storage dtype ON DEVICE: the wire
                 # carries uint16/uint8, not the kernel's float32
                 _D2H_SAVED.inc(data.size * 4 - data.nbytes)
-        with profiling.span("fusion.write", item=int(x0), nbytes=nb):
+        write_span = (profiling.span("fusion.epilogue.write", item=int(x0),
+                                     nbytes=nb) if epi else
+                      profiling.span("fusion.write", item=int(x0), nbytes=nb))
+        with write_span:
             if zarr_ct is not None:
                 c, t = zarr_ct
-                out_ds.write(data[..., None, None], (x0, 0, 0, c, t))
+                ds.write(data[..., None, None], (x0, 0, 0, c, t))
             else:
-                out_ds.write(data, (x0, 0, 0))
+                ds.write(data, (x0, 0, 0))
+            if epi:
+                _EPI_WRITE_BYTES.inc(data.nbytes)
 
     with ThreadPoolExecutor(max_workers=max(1, io_threads)) as pool:
-        list(pool.map(drain, slabs))
+        list(pool.map(drain, jobs))
+    return levels
 
 def _write_block(out_ds, data, block, zarr_ct):
+    from ..parallel.mesh import drain_device
+
     with profiling.span("fusion.write", item=tuple(map(int, block.offset)),
-                        nbytes=int(data.nbytes)):
+                        nbytes=int(data.nbytes), device=drain_device()):
         if zarr_ct is not None:
             c, t = zarr_ct
             out_ds.write(data[..., None, None], (*block.offset, c, t))
@@ -690,26 +784,83 @@ def _write_block(out_ds, data, block, zarr_ct):
             out_ds.write(data, block.offset)
 
 
+def _write_epilogue_block(ds, data, offset, zarr_ct):
+    """One pyramid sub-block produced by the sharded per-block epilogue,
+    written by the device worker that drained it (its bytes crossed the
+    wire inside the batch shard fetch — counted as epilogue traffic
+    here)."""
+    from ..parallel.mesh import drain_device
+
+    with profiling.span("fusion.epilogue.write",
+                        item=tuple(map(int, offset)),
+                        nbytes=int(data.nbytes), device=drain_device()):
+        if zarr_ct is not None:
+            c, t = zarr_ct
+            ds.write(data[..., None, None], (*offset, c, t))
+        else:
+            ds.write(data, offset)
+    _EPI_D2H_BYTES.inc(int(data.nbytes))
+    _EPI_WRITE_BYTES.inc(int(data.nbytes))
+
+
+def eligible_epilogue_levels(pyramid, compute_block, full_dims):
+    """The PREFIX of pyramid levels the per-block sharded epilogue can
+    materialize. Per axis, a level's absolute factor must (1) divide the
+    compute block exactly, so block boundaries align with reduction
+    windows; (2) be no wider than the axis, so no window needs the
+    edge-replication only the whole-volume composite path can do; and
+    (3) leave the per-block level piece a whole multiple of the level
+    dataset's storage chunk, so concurrent per-device writers never
+    read-modify-write a shared chunk. Later levels chain off earlier
+    ones, so the first ineligible level stops the prefix; the remaining
+    levels fall back to the container-reread downsample stage (which then
+    reads the much smaller last materialized level, not full res)."""
+    out = []
+    for lv in (pyramid or ()):
+        ok = all(int(cb) % int(a) == 0 and int(dim) >= int(a)
+                 for cb, a, dim in zip(compute_block, lv.abs_factor,
+                                       full_dims))
+        if ok:
+            chunk = lv.ds.block_size[:3]
+            ok = all((int(cb) // int(a)) % max(int(c), 1) == 0
+                     for cb, a, c in zip(compute_block, lv.abs_factor,
+                                         chunk))
+        if not ok:
+            break
+        out.append(lv)
+    return out
+
+
 def _fuse_volume_sharded(
     sd, loader, views, out_ds, bbox, compute_block, fusion_type, blend,
     aniso, out_dtype, min_intensity, max_intensity, masks, mask_offset,
     zarr_ct, stats, coefficients, n_dev, io_threads, progress,
-    patch_quantum=32,
+    patch_quantum=32, pyramid=None,
 ):
     """Multi-device per-block fusion: the block work list is bucketed by
     kernel signature, batched ``n_dev`` at a time, sharded over the local
-    device mesh, and written by host threads — the TPU replacement of the
-    reference's Spark map over grid blocks (SparkAffineFusion.java:480-482).
+    device mesh — the TPU replacement of the reference's Spark map over
+    grid blocks (SparkAffineFusion.java:480-482).
 
     Host prefetch for batch k+1 overlaps device compute for batch k
-    (double buffering); writers own disjoint chunks so the write pool needs
-    no locks (the reference's no-shuffle invariant)."""
+    (double buffering); writers own disjoint chunks so no write needs a
+    lock (the reference's no-shuffle invariant). Each device's worker
+    drains and WRITES its own shard directly (``device_drain`` in
+    parallel.mesh) — the driver thread performs no D2H and no writes —
+    except into h5py containers, whose single-writer rule keeps the
+    driver-drained path. ``pyramid`` levels whose factors divide
+    ``compute_block`` are produced per block as a kernel epilogue and
+    written by the same per-device workers."""
     from concurrent.futures import ThreadPoolExecutor
 
+    from ..io.chunkstore import StorageFormat
     from ..parallel.mesh import make_mesh, make_sharded_fuser, run_sharded_batches
 
     grid = create_grid(bbox.shape, compute_block, compute_block)
     inside_offset = mask_offset if masks else (0.0, 0.0, 0.0)
+    epi = eligible_epilogue_levels(pyramid, compute_block, bbox.shape)
+    epi_rels = tuple(tuple(int(v) for v in lv.rel) for lv in epi)
+    direct = getattr(out_ds.store, "format", None) != StorageFormat.HDF5
 
     # multi-host: slice the grid BEFORE bucketing so batching heuristics
     # (per_dev) see this process's actual work list
@@ -746,6 +897,7 @@ def _fuse_volume_sharded(
     mesh = make_mesh(n_dev)
     mi = np.float32(min_intensity)
     ma = np.float32(max_intensity)
+    pwritten: dict[tuple, int] = {}
     pool = ThreadPoolExecutor(max_workers=max(1, io_threads))
     try:
         for key, items in sorted(buckets.items(), key=lambda kv: str(kv[0])):
@@ -753,7 +905,7 @@ def _fuse_volume_sharded(
             fuser = make_sharded_fuser(
                 mesh, compute_block, fusion_type, kernel=kernel,
                 with_coeffs=coefficients is not None and kernel == "gather",
-                out_dtype=out_dtype, masks=masks,
+                out_dtype=out_dtype, masks=masks, pyramid=epi_rels,
             )
             stats.compile_keys.add((compute_block, key, fusion_type,
                                     out_dtype, masks, "sharded"))
@@ -774,22 +926,37 @@ def _fuse_volume_sharded(
                 return arrs
 
             def kernel_call(*stacked):
-                # dispatch only — return the DEVICE array and let the work
-                # loop's jax.device_get fetch it, so the early-dispatch
+                # dispatch only — return the DEVICE arrays and let the work
+                # loop's per-device drains fetch them, so the early-dispatch
                 # window actually overlaps compute with this batch's D2H
                 # (a blocking np.asarray here serialized the pipeline,
-                # ADVICE r5); wsum is dropped on device, never fetched
+                # ADVICE r5); wsum is dropped on device, never fetched.
+                # Epilogue pyramid levels ride the same dispatch.
                 with profiling.span("fusion.kernel"):
-                    out, _wsum = fuser(mi, ma, *stacked)
-                    return out
+                    out, _wsum, *lvls = fuser(mi, ma, *stacked)
+                    return (out, *lvls)
 
             written: dict[tuple, int] = {}
 
-            def consume(item, data):
+            def consume(item, data, *lvls):
                 block, bg, plans = item
                 sl = tuple(slice(0, s) for s in block.size)
                 _write_block(out_ds, data[sl], block, zarr_ct)
                 written[tuple(block.offset)] = int(np.prod(block.size))
+                for lv, ldata in zip(epi, lvls):
+                    a = lv.abs_factor
+                    off = tuple(int(o) // int(f)
+                                for o, f in zip(block.offset, a))
+                    end = tuple(min(int(d), (int(o) + int(s)) // int(f))
+                                for d, o, s, f in zip(lv.dims, block.offset,
+                                                      block.size, a))
+                    size = tuple(e - o for e, o in zip(end, off))
+                    if any(s <= 0 for s in size):
+                        continue
+                    _write_epilogue_block(
+                        lv.ds, ldata[tuple(slice(0, s) for s in size)],
+                        off, zarr_ct)
+                    pwritten[(a, off)] = int(np.prod(size))
 
             # pack several blocks per device per batch: fusion dispatches
             # are compute-light, so fewer+bigger launches amortize dispatch
@@ -804,18 +971,28 @@ def _fuse_volume_sharded(
             budget = config.get_bytes("BST_PER_DEV_BUDGET")
             per_dev = max(1, min(4, len(items) // max(n_dev, 1),
                                  budget // max(item_bytes, 1)))
+            # device-resident per item: converted block + f32 wsum + the
+            # epilogue levels
+            item_out = int(np.prod(compute_block)) \
+                * (np.dtype(out_dtype or "float32").itemsize + 4)
+            for lv in epi:
+                item_out += int(np.prod(
+                    [int(c) // int(a) for c, a in zip(compute_block,
+                                                      lv.abs_factor)])) \
+                    * np.dtype(out_dtype or "float32").itemsize
             run_sharded_batches(
                 items, build, kernel_call, consume, n_dev, pool,
                 label=f"fusion batch {key}", progress=progress,
                 per_dev=per_dev,
-                # device-resident per item: converted block + f32 wsum
-                out_bytes_per_item=int(np.prod(compute_block))
-                * (np.dtype(out_dtype or "float32").itemsize + 4),
+                out_bytes_per_item=item_out,
                 workspace_mult=3.0,
+                device_drain=direct,
             )
             stats.voxels += sum(written.values())
     finally:
         pool.shutdown(wait=True)
+    stats.pyramid_levels = len(epi)
+    stats.pyramid_voxels += sum(pwritten.values())
 
 
 def _record_fusion_stage(stage: str, stats: "FusionStats",
@@ -835,6 +1012,13 @@ def _record_fusion_stage(stage: str, stats: "FusionStats",
         voxels_per_s=round(stats.voxels / max(stats.seconds, 1e-9), 1),
         compile_keys=len(stats.compile_keys),
         path=path_kind,
+        # epilogue output reported SEPARATELY from the full-res rate so
+        # pyramid voxels can never masquerade as (or hide) a kernel change
+        pyramid_levels=stats.pyramid_levels,
+        pyramid_voxels=stats.pyramid_voxels,
+        voxels_per_s_incl_pyramid=round(
+            (stats.voxels + stats.pyramid_voxels)
+            / max(stats.seconds, 1e-9), 1),
     )
 
 
@@ -860,6 +1044,7 @@ def fuse_volume(
     devices: int | None = None,
     io_threads: int = 4,
     device_resident: bool | None = None,
+    pyramid: list[PyramidLevel] | None = None,
 ) -> FusionStats:
     """Fuse ``views`` into ``out_ds`` over ``bbox``.
 
@@ -869,6 +1054,13 @@ def fuse_volume(
     ``devices``: number of local devices to shard the block grid over
     (default: all); with one device the whole-volume device-resident scan
     path is tried first (``device_resident=False`` disables it).
+    ``pyramid``: downsample levels to materialize as a fused multiscale
+    epilogue while the data is device-resident — shipped in the same
+    drain, bit-identical to the container-reread downsample. The composite
+    path produces every level; the sharded path the
+    :func:`eligible_epilogue_levels` prefix; the per-block fallback none
+    (``stats.pyramid_levels`` says how many were done — the rest is the
+    downsample stage's job).
     """
     stats = FusionStats()
     t0 = time.time()
@@ -891,7 +1083,7 @@ def fuse_volume(
             sd, loader, views, out_ds, bbox, compute_block, fusion_type,
             blend or BlendParams(), aniso, out_dtype, min_intensity,
             max_intensity, masks, mask_offset, zarr_ct, stats, coefficients,
-            n_dev, io_threads, progress,
+            n_dev, io_threads, progress, pyramid=pyramid,
         )
         stats.seconds = time.time() - t0
         _record_fusion_stage("affine-fusion", stats, "sharded")
@@ -915,9 +1107,14 @@ def fuse_volume(
             coefficients=coefficients,
         ))
     if vol is not None:
-        _drain_device_volume(vol, out_ds, zarr_ct, io_threads=io_threads)
+        levels = _drain_device_volume(vol, out_ds, zarr_ct,
+                                      pyramid=pyramid or (),
+                                      out_dtype=out_dtype)
         stats.blocks = len(grid)
         stats.voxels = bbox.num_elements
+        stats.pyramid_levels = len(levels)
+        stats.pyramid_voxels = sum(int(np.prod(lv.dims))
+                                   for lv, _ in levels)
         stats.seconds = time.time() - t0
         _record_fusion_stage("affine-fusion", stats, "composite")
         return stats

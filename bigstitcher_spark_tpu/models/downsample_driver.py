@@ -13,7 +13,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-from ..io.chunkstore import ChunkStore, Dataset
+from ..io.chunkstore import ChunkStore, Dataset, StorageFormat
 from ..io.container import MultiResolutionLevelInfo
 from ..ops.downsample import downsample_block
 from ..parallel.mesh import make_mesh, run_sharded_batches, shard_jit
@@ -89,11 +89,14 @@ def _make_downsample_kernel_cached(n_dev: int, rel_t):
 def run_sharded_downsample(jobs, read_job, write_job, rel, devices=None,
                            io_threads: int = 8, per_dev: int = 4,
                            label: str = "downsample block",
-                           multihost: bool = True) -> None:
+                           multihost: bool = True,
+                           device_drain: bool = False) -> None:
     """Downsample every (job, src-box) through the mesh. ``read_job(job)``
     returns the raw source box (size = out_block * rel, edge-padded);
     ``write_job(job, data)`` converts + writes. Jobs are bucketed by source
-    shape so one compile serves each shape."""
+    shape so one compile serves each shape. ``device_drain`` routes each
+    device's output shard through its own drain+write worker
+    (parallel.mesh) — only safe for parallel-writer stores, never h5py."""
     import jax
 
     n_dev = devices if devices is not None else len(jax.local_devices())
@@ -122,6 +125,7 @@ def run_sharded_downsample(jobs, read_job, write_job, rel, devices=None,
                 multihost=multihost,
                 out_bytes_per_item=out_vox * 4,  # f32 device output
                 workspace_mult=3.0,              # f32 cast of the input
+                device_drain=device_drain,
             )
     finally:
         pool.shutdown(wait=True)
@@ -154,12 +158,28 @@ def downsample_pyramid_level(
     ct: tuple[int, int] = (0, 0),
     devices: int | None = None,
     io_threads: int = 8,
+    skip_existing: bool = False,
 ) -> None:
     """Fill ``dst_info`` from ``src_info`` by relative-factor averaging,
-    block-sharded over the device mesh (SparkDownsample.java:141-177)."""
+    block-sharded over the device mesh (SparkDownsample.java:141-177).
+
+    ``skip_existing``: return immediately when the fusion drivers already
+    materialized this level for this (channel, timepoint) slot as a fused
+    multiscale epilogue (the container records that per level; epilogue
+    output is bit-identical to this path, so there is nothing to redo —
+    and crucially no full-res container re-read)."""
     import time
 
     from .. import observe
+    from ..io.container import epilogue_written
+
+    if skip_existing and epilogue_written(store, dst_info.dataset, ct):
+        observe.progress.record_stage(
+            f"downsample {dst_info.dataset.strip('/')}",
+            done=0, total=0, blocks=0, seconds=0.0,
+            skipped="fusion epilogue already materialized this level",
+        )
+        return
 
     t0 = time.time()
     src = store.open_dataset(src_info.dataset.strip("/"))
@@ -191,7 +211,11 @@ def downsample_pyramid_level(
         write3d(_convert_to_dtype(out, dst.dtype), block.offset)
 
     run_sharded_downsample(grid, read_job, write_job, rel, devices=devices,
-                           io_threads=io_threads)
+                           io_threads=io_threads,
+                           # per-device direct chunk writes wherever the
+                           # store allows concurrent writers
+                           device_drain=getattr(store, "format", None)
+                           != StorageFormat.HDF5)
     dt = time.time() - t0
     observe.progress.record_stage(
         f"downsample {dst_info.dataset.strip('/')}",

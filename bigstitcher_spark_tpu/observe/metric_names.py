@@ -40,6 +40,12 @@ METRICS: dict[str, str] = {
         "H2D bytes avoided by native-dtype transport (vs f32 upload)",
     "bst_xfer_d2h_bytes_saved_total":
         "D2H bytes avoided by on-device output conversion",
+    # fused multiscale epilogue (models/affine_fusion.py): pyramid-level
+    # bytes that rode the fusion drain instead of a container re-read pass
+    "bst_epilogue_d2h_bytes_total":
+        "pyramid-level bytes fetched device-to-host by the fusion epilogue",
+    "bst_epilogue_write_bytes_total":
+        "pyramid-level bytes written by the fusion epilogue drain",
     # HBM-resident composite tile cache (models/affine_fusion.py)
     "bst_tile_cache_hits_total": "composite tile cache hits",
     "bst_tile_cache_misses_total": "composite tile cache misses",
@@ -82,6 +88,14 @@ SPANS: dict[str, str] = {
     "fusion.h2d_tiles": "composite-path tile upload into HBM",
     "fusion.d2h": "device-to-host fetch of fused output (slab or block)",
     "fusion.write": "container write of fused output (slab or block)",
+    # fused multiscale epilogue: pyramid levels computed in HBM and shipped
+    # in the same drain as the full-res volume (never a second full-res
+    # pass — trace-counted by the tier-1 single-drain test)
+    "fusion.epilogue.kernel":
+        "on-device downsample-pyramid computation (epilogue dispatch)",
+    "fusion.epilogue.d2h": "device-to-host fetch of an epilogue pyramid slab",
+    "fusion.epilogue.write":
+        "container write of an epilogue pyramid slab or block",
     # detection / stitching / matching / nonrigid drivers
     "detection.kernel": "DoG + localization device computation",
     "stitching.extract": "overlap crop extraction for one pair batch",

@@ -14,6 +14,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @functools.partial(jax.jit, static_argnames=("factors",))
@@ -32,6 +33,44 @@ def downsample_block(block: jnp.ndarray, factors: tuple[int, ...]) -> jnp.ndarra
         shape.insert(d + 1, f)
         x = x.reshape(shape).mean(axis=d + 1)
     return x
+
+
+def convert_storage(x: jnp.ndarray, out_dtype: str) -> jnp.ndarray:
+    """Round/clip a float result to the storage dtype — the traced twin of
+    the downsample drivers' host-side conversion (``np.clip(np.round(x),
+    lo, hi).astype``) so epilogue-produced pyramid levels match the
+    container-reread path bit for bit."""
+    dt = np.dtype(out_dtype)
+    if np.issubdtype(dt, np.integer):
+        info = np.iinfo(dt)
+        x = jnp.clip(jnp.round(x), info.min, info.max)
+    return x.astype(dt)
+
+
+@functools.partial(jax.jit, static_argnames=("factors", "dims", "out_dtype"))
+def downsample_level(prev: jnp.ndarray, factors: tuple[int, ...],
+                     dims: tuple[int, ...], out_dtype: str) -> jnp.ndarray:
+    """One pyramid level from the previous level's STORED-dtype array,
+    while it is still device-resident (the fusion multiscale epilogue).
+
+    Reproduces the container-reread path (``read_padded`` +
+    :func:`downsample_block` + host round/clip) exactly: the reduction
+    extent is ``dims * factors`` — trailing source voxels beyond it are
+    dropped (level dims floor-divide), and axes thinner than one window
+    are edge-replicated, the ``read_padded`` rule — then a float32 mean
+    per window and a round/clip back to the storage dtype. Chaining
+    levels through the storage dtype between steps keeps them
+    bit-identical to levels computed by re-reading the stored previous
+    level from the container."""
+    needed = tuple(int(d) * int(f) for d, f in zip(dims, factors))
+    x = prev[tuple(slice(0, min(n, int(s)))
+                   for n, s in zip(needed, prev.shape))]
+    pad = tuple((0, n - min(n, int(s)))
+                for n, s in zip(needed, prev.shape))
+    if any(p for _, p in pad):
+        x = jnp.pad(x, pad, mode="edge")
+    return convert_storage(
+        downsample_block(x, tuple(int(f) for f in factors)), out_dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("axis",))

@@ -19,6 +19,7 @@ out).
 from __future__ import annotations
 
 import functools
+import threading
 
 from typing import Sequence
 
@@ -44,6 +45,19 @@ _H2D_BYTES = _metrics.counter("bst_xfer_h2d_bytes_total")
 _D2H_BYTES = _metrics.counter("bst_xfer_d2h_bytes_total")
 _H2D_SAVED = _metrics.counter("bst_xfer_h2d_bytes_saved_total")
 _D2H_SAVED = _metrics.counter("bst_xfer_d2h_bytes_saved_total")
+
+
+# which device's shard the current thread is draining (set by the
+# per-device drain workers of run_sharded_batches); consumers use it to
+# attribute their spans — e.g. models/affine_fusion's `fusion.write` — to
+# the owning device's trace track instead of an anonymous host thread
+_DRAIN_TLS = threading.local()
+
+
+def drain_device() -> int | None:
+    """Device ordinal whose shard the calling thread is draining, or None
+    outside a per-device drain worker."""
+    return getattr(_DRAIN_TLS, "device", None)
 
 
 def narrow_dtype_savings(arrays) -> int:
@@ -85,6 +99,8 @@ def make_sharded_fuser(
     with_coeffs: bool = False,
     out_dtype: str | None = None,     # fuse intensity conversion on device
     masks: bool = False,
+    pyramid: tuple = (),              # per-level relative factors: the
+                                      # fused multiscale epilogue
 ):
     """Compile a fuser for a BATCH of blocks sharded over the mesh.
 
@@ -92,9 +108,15 @@ def make_sharded_fuser(
     runs) reuse the jitted callable instead of recompiling per call.
 
     Inputs get a leading batch axis B (a multiple of mesh size; pad with
-    valid=0 blocks). Returns ``fn(*arrays) -> (out (B,*block_shape), wsum)``
-    where ``out`` is already intensity-converted when ``out_dtype`` is given
-    (min_i/max_i are appended scalar args in that case)."""
+    valid=0 blocks). Returns ``fn(*arrays) -> (out (B,*block_shape), wsum[,
+    level1, ...])`` where ``out`` is already intensity-converted when
+    ``out_dtype`` is given (min_i/max_i are appended scalar args in that
+    case). ``pyramid`` chains per-block downsample levels as a kernel
+    epilogue — each a strided f32 mean of the previous level quantized to
+    the storage dtype between steps (ops.downsample.convert_storage), the
+    exact container-reread semantics — so the whole pyramid ships in the
+    block's one drain; callers must pre-check divisibility
+    (models.affine_fusion.eligible_epilogue_levels)."""
     if kernel == "gather":
         def core(p, a, o, d, b, r, v, io, c=None, ca=None):
             return F.fuse_block_impl(
@@ -132,7 +154,17 @@ def make_sharded_fuser(
                          ).astype(np.dtype(out_dtype))
         elif out_dtype is not None:
             fused = F._convert_intensity_expr(fused, min_i, max_i, out_dtype)
-        return fused, wsum
+        levels = []
+        if pyramid:
+            from ..ops.downsample import convert_storage, downsample_block
+
+            cur = fused
+            dt = out_dtype or "float32"
+            for rel in pyramid:
+                cur = convert_storage(
+                    downsample_block(cur, tuple(int(f) for f in rel)), dt)
+                levels.append(cur)
+        return (fused, wsum, *levels)
 
     def batched(min_i, max_i, *arrays):
         return jax.vmap(lambda *a: one(a, min_i, max_i))(*arrays)
@@ -142,7 +174,7 @@ def make_sharded_fuser(
     return jax.jit(
         batched,
         in_shardings=(repl, repl) + (shard,) * n_in,
-        out_shardings=(shard, shard),
+        out_shardings=(shard,) * (2 + len(pyramid)),
     )
 
 
@@ -172,6 +204,7 @@ def run_sharded_batches(
     multihost: bool = False,
     out_bytes_per_item: int = 0,
     workspace_mult: float = 2.0,
+    device_drain: bool = False,
 ):
     """The shared multi-device work loop: every sharded stage driver (fusion,
     detection, nonrigid, downsample) is this pattern — the TPU replacement of
@@ -207,7 +240,19 @@ def run_sharded_batches(
     ``multihost=True`` (block-writing stages only — outputs must be disjoint
     chunks) first takes this process's deterministic slice of ``items``, so
     the same driver run on N hosts covers the grid exactly once
-    (parallel.distributed; the reference's executor model, SURVEY §2.5)."""
+    (parallel.distributed; the reference's executor model, SURVEY §2.5).
+
+    ``device_drain=True`` replaces the driver's single batched
+    ``jax.device_get`` + consume fan-out with PER-DEVICE drain workers:
+    each device's shard of the batch outputs is fetched by its own thread
+    (one pipelined ``device_get`` per device, ``mesh.d2h`` span attributed
+    to that device's trace track) which then runs ``consume`` for exactly
+    the items that computed on that device — so the driver thread performs
+    zero D2H and zero writes, one device's wire transfer overlaps another
+    device's chunk writes, and writers still own disjoint chunks (the
+    no-shuffle invariant, now per device; ROADMAP item 3b). Callers must
+    only enable it when ``consume`` tolerates ``n_dev``-way concurrency —
+    h5py-backed containers (single-writer) must keep the default path."""
     from .retry import run_with_retry
 
     if multihost:
@@ -220,6 +265,12 @@ def run_sharded_batches(
     batches = [list(items[i:i + group]) for i in range(0, len(items), group)]
     if not batches:
         return
+    drain_pool = None
+    if device_drain:
+        from concurrent.futures import ThreadPoolExecutor
+
+        drain_pool = ThreadPoolExecutor(max_workers=max(1, n_dev),
+                                        thread_name_prefix="bst-dev-drain")
     window = InflightWindow()
     prefetched = {0: [pool.submit(build, it) for it in batches[0]]}
     dispatched: dict[int, tuple] = {}   # bi -> (outs, charged bytes)
@@ -317,25 +368,30 @@ def run_sharded_batches(
         # fetch below only waits on THIS batch's buffers — a data
         # dependency)
         dispatch_ahead(bi)
-        # device-array nbytes are free to read pre-fetch: the span carries
-        # the batch's wire payload for the trace-report D2H decomposition
-        d2h_nbytes = sum(int(getattr(o, "nbytes", 0)) for o in outs)
         try:
-            with profiling.span("mesh.d2h", stage=label, item=int(bi),
-                                nbytes=d2h_nbytes):
-                outs = jax.device_get(list(outs))  # pipelined batched fetch
+            if drain_pool is not None:
+                _drain_per_device(outs, batch, consume, drain_pool, label, bi)
+            else:
+                # device-array nbytes are free to read pre-fetch: the span
+                # carries the batch's wire payload for the trace-report D2H
+                # decomposition
+                d2h_nbytes = sum(int(getattr(o, "nbytes", 0)) for o in outs)
+                with profiling.span("mesh.d2h", stage=label, item=int(bi),
+                                    nbytes=d2h_nbytes):
+                    outs = jax.device_get(list(outs))  # pipelined batch fetch
         finally:
             # drained or dead, the buffers leave the ledger either way —
             # a fetch error must not shrink the window for the whole run
             window.release(cost)
-        _D2H_BYTES.inc(sum(int(getattr(o, "nbytes", 0)) for o in outs))
-        _D2H_SAVED.inc(narrow_dtype_savings(outs))
-        wfuts = [
-            pool.submit(consume, it, *(o[i] for o in outs))
-            for i, it in enumerate(batch)
-        ]
-        for w in wfuts:
-            w.result()
+        if drain_pool is None:
+            _D2H_BYTES.inc(sum(int(getattr(o, "nbytes", 0)) for o in outs))
+            _D2H_SAVED.inc(narrow_dtype_savings(outs))
+            wfuts = [
+                pool.submit(consume, it, *(o[i] for o in outs))
+                for i, it in enumerate(batch)
+            ]
+            for w in wfuts:
+                w.result()
         completed.add(bi)
         if progress:
             observe.log(f"  {label}: batch {bi + 1}/{len(batches)} done",
@@ -344,8 +400,52 @@ def run_sharded_batches(
     try:
         run_with_retry(list(enumerate(batches)), process_batch, label=label)
     finally:
+        if drain_pool is not None:
+            drain_pool.shutdown(wait=True)
         for _outs, cost in dispatched.values():
             window.release(cost)  # keep the process-wide gauge honest
+
+
+def _drain_per_device(outs, batch, consume, drain_pool, label, bi):
+    """Fetch + consume one dispatched batch with one drain worker per
+    device shard. Shards are grouped by their batch-axis row start (the
+    1-D block sharding is contiguous, so row start order == mesh device
+    order); each worker fetches its device's shard of every output in one
+    pipelined ``device_get`` and consumes exactly the rows that device
+    computed, writes included. Errors propagate to the caller (the retry
+    layer re-runs the whole batch; chunk writes are idempotent)."""
+    per_dev: dict[int, list] = {}
+    for oi, o in enumerate(outs):
+        shards = getattr(o, "addressable_shards", None) or []
+        if not shards:   # already-committed single-device array
+            per_dev.setdefault(0, [None] * len(outs))[oi] = o
+            continue
+        for sh in shards:
+            r0 = int(sh.index[0].start or 0) if sh.index else 0
+            per_dev.setdefault(r0, [None] * len(outs))[oi] = sh.data
+
+    def drain_rows(di, r0):
+        _DRAIN_TLS.device = di
+        try:
+            parts = per_dev[r0]
+            nb = sum(int(getattr(p, "nbytes", 0)) for p in parts)
+            with profiling.span("mesh.d2h", stage=label, item=int(bi),
+                                device=di, nbytes=nb):
+                datas = jax.device_get(parts)
+            _D2H_BYTES.inc(sum(int(getattr(d, "nbytes", 0)) for d in datas))
+            _D2H_SAVED.inc(narrow_dtype_savings(datas))
+            for li in range(int(datas[0].shape[0])):
+                gi = r0 + li
+                if gi >= len(batch):
+                    break    # batch-axis padding rows carry no work
+                consume(batch[gi], *(d[li] for d in datas))
+        finally:
+            _DRAIN_TLS.device = None
+
+    futs = [drain_pool.submit(drain_rows, di, r0)
+            for di, r0 in enumerate(sorted(per_dev))]
+    for f in futs:
+        f.result()
 
 
 def shard_jit(fn, mesh: Mesh, n_in: int, n_repl: int = 0, n_out=None,

@@ -39,6 +39,13 @@ Design:
 - **Failures** re-dispatch: a task whose device call dies is retried on
   the OTHER devices (round-robin, the observed device excluded) so one
   poisoned chip degrades capacity instead of killing the run.
+- **Drains may write**: a ``drain`` callback runs on its device's own
+  worker thread and may write its tasks' disjoint output chunks directly
+  (the chunkstore is thread-safe and write-generation-aware) instead of
+  collecting results back to the caller — the same device-owns-its-output
+  rule the sharded work loop's ``device_drain`` mode (parallel.mesh)
+  applies to the block-parallel fusion/downsample drivers, keeping every
+  result's D2H and write on the worker track that computed it.
 
 Instrumented through ``observe.metrics``: per-device dispatch/busy
 counters (``bst_pair_dispatch_total`` / ``bst_pair_busy_ms_total``,

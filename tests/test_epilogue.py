@@ -1,0 +1,402 @@
+"""Fused multiscale epilogue + per-device direct chunk writes (ROADMAP
+item 3).
+
+Acceptance contract of the single-drain PR: epilogue-produced pyramid
+levels are BIT-IDENTICAL to the container-reread ``downsample_pyramid_level``
+path (all rel-factor shapes, incl. anisotropic and thin-axis edge-pad);
+with the epilogue on, the full-res volume crosses the wire exactly once
+(trace-counted) and total D2H stays within 1.2x of the full-res-only
+drain; in sharded fusion the driver thread performs zero ``fusion.write``
+spans — every write is attributed to a device worker track, each device
+writes only its own disjoint chunks, and write-generations stay
+consistent.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from bigstitcher_spark_tpu import profiling
+from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+from bigstitcher_spark_tpu.io.container import (
+    create_fusion_container,
+    epilogue_written,
+    read_container_meta,
+)
+from bigstitcher_spark_tpu.io.dataset_io import ViewLoader
+from bigstitcher_spark_tpu.io.spimdata import SpimData
+from bigstitcher_spark_tpu.models.affine_fusion import (
+    PyramidLevel,
+    eligible_epilogue_levels,
+    fuse_volume,
+)
+from bigstitcher_spark_tpu.models.downsample_driver import (
+    downsample_pyramid_level,
+)
+from bigstitcher_spark_tpu.observe import trace
+from bigstitcher_spark_tpu.utils.viewselect import maximal_bounding_box
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_state():
+    trace.reset()
+    profiling.enable(False)
+    profiling.get().reset()
+    yield
+    trace.reset()
+    profiling.enable(False)
+    profiling.get().reset()
+
+
+@pytest.fixture(scope="module")
+def project(tmp_path_factory):
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    return make_synthetic_project(
+        str(tmp_path_factory.mktemp("epi") / "proj"),
+        n_tiles=(2, 2, 1), tile_size=(48, 48, 24), overlap=12,
+        jitter=2.0, seed=13, block_size=(16, 16, 8), n_beads_per_tile=15,
+    )
+
+
+def _setup(project):
+    sd = SpimData.load(project.xml_path)
+    loader = ViewLoader(sd)
+    views = sd.view_ids()
+    bbox = maximal_bounding_box(sd, views)
+    return sd, loader, views, bbox
+
+
+def _container(path, xml, bbox, steps, block=(16, 16, 8)):
+    create_fusion_container(
+        str(path), StorageFormat.ZARR, xml, 1, 1, bbox,
+        data_type="uint16", block_size=block, downsamplings=steps,
+        min_intensity=0.0, max_intensity=65535.0)
+    store = ChunkStore.open(str(path))
+    return store, read_container_meta(store).mr_infos[0]
+
+
+def _pyramid(store, mr):
+    return [PyramidLevel(
+        ds=store.open_dataset(mr[lvl].dataset.strip("/")),
+        rel=tuple(int(v) for v in mr[lvl].relativeDownsampling[:3]),
+        abs_factor=tuple(int(v) for v in mr[lvl].absoluteDownsampling[:3]),
+        dims=tuple(int(v) for v in mr[lvl].dimensions[:3]),
+    ) for lvl in range(1, len(mr))]
+
+
+def _fuse(sd, loader, views, bbox, store, mr, *, pyramid=False, **kw):
+    ds = store.open_dataset(mr[0].dataset.strip("/"))
+    return fuse_volume(
+        sd, loader, views, ds, bbox, block_size=(16, 16, 8),
+        block_scale=(2, 2, 1), out_dtype="uint16", min_intensity=0.0,
+        max_intensity=65535.0, zarr_ct=(0, 0),
+        pyramid=_pyramid(store, mr) if pyramid else None, **kw)
+
+
+def _reread_levels(store, mr, start=1):
+    for lvl in range(start, len(mr)):
+        downsample_pyramid_level(store, mr[lvl - 1], mr[lvl], True, (0, 0))
+
+
+def _reread_reference(tmp_path, name, xml, bbox, steps, src_store, src_mr,
+                      block=(16, 16, 8)):
+    """Reference container: the epilogue run's OWN s0 copied over (bit
+    cheap), then every level recomputed by the container-reread driver —
+    the exact flow the epilogue replaces, on identical input."""
+    store, mr = _container(tmp_path / name, xml, bbox, steps, block=block)
+    s0 = src_store.open_dataset(src_mr[0].dataset.strip("/")).read_full()
+    store.open_dataset(mr[0].dataset.strip("/")).write(s0, (0,) * 5)
+    _reread_levels(store, mr)
+    return store, mr
+
+
+def _levels_equal(store_a, mr_a, store_b, mr_b):
+    for lvl in range(len(mr_a)):
+        a = store_a.open_dataset(mr_a[lvl].dataset.strip("/")).read_full()
+        b = store_b.open_dataset(mr_b[lvl].dataset.strip("/")).read_full()
+        assert a.shape == b.shape
+        assert (a == b).all(), f"level {lvl} diverged"
+        assert a.std() > 0 or lvl == 0, f"level {lvl} empty"
+
+
+ANISO_STEPS = [[1, 1, 1], [2, 2, 1], [4, 4, 2]]
+
+
+class TestEpilogueParity:
+    def test_composite_bit_identical_anisotropic(self, project, tmp_path,
+                                                 monkeypatch):
+        """Whole-volume composite epilogue vs the container-reread path,
+        anisotropic rel factors (2,2,1)+(2,2,2), odd level dims."""
+        monkeypatch.setenv("BST_WRITE_THREADS", "3")  # knob-path exercise
+        sd, loader, views, bbox = _setup(project)
+        s1, mr1 = _container(tmp_path / "epi.zarr", project.xml_path, bbox,
+                             ANISO_STEPS)
+        st = _fuse(sd, loader, views, bbox, s1, mr1, pyramid=True, devices=1)
+        assert st.pyramid_levels == 2
+        assert st.pyramid_voxels == sum(
+            int(np.prod(mr1[i].dimensions[:3])) for i in (1, 2))
+        assert any("composite" in str(k) for k in st.compile_keys)
+
+        s2, mr2 = _reread_reference(tmp_path, "ref.zarr", project.xml_path,
+                                    bbox, ANISO_STEPS, s1, mr1)
+        _levels_equal(s1, mr1, s2, mr2)
+
+    def test_composite_thin_axis_edge_pad(self, tmp_path_factory, tmp_path):
+        """A level window wider than the axis triggers the read_padded
+        edge-replication rule — the device epilogue must reproduce it."""
+        from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+        proj = make_synthetic_project(
+            str(tmp_path_factory.mktemp("thin") / "proj"),
+            n_tiles=(2, 1, 1), tile_size=(32, 32, 6), overlap=8,
+            jitter=0.0, seed=7, block_size=(16, 16, 4), n_beads_per_tile=8)
+        sd, loader, views, bbox = _setup(proj)
+        steps = [[1, 1, 1], [2, 2, 8]]  # z window (8) > z extent (~6)
+        assert bbox.shape[2] < 8
+        s1, mr1 = _container(tmp_path / "thin_epi.zarr", proj.xml_path,
+                             bbox, steps, block=(16, 16, 4))
+        st = fuse_volume(
+            sd, loader, views,
+            s1.open_dataset(mr1[0].dataset.strip("/")), bbox,
+            block_size=(16, 16, 4), block_scale=(2, 2, 1),
+            out_dtype="uint16", min_intensity=0.0, max_intensity=65535.0,
+            zarr_ct=(0, 0), pyramid=_pyramid(s1, mr1), devices=1)
+        assert st.pyramid_levels == 1
+        s2, mr2 = _reread_reference(tmp_path, "thin_ref.zarr",
+                                    proj.xml_path, bbox, steps, s1, mr1,
+                                    block=(16, 16, 4))
+        _levels_equal(s1, mr1, s2, mr2)
+
+    def test_sharded_prefix_plus_fallback_bit_identical(self, project,
+                                                        tmp_path):
+        """Sharded per-block epilogue materializes the chunk-aligned
+        prefix (level 1 here); the reread fallback tops up the rest from
+        the materialized level — everything bit-identical to the pure
+        reread flow."""
+        import jax
+
+        assert len(jax.devices()) >= 8
+        sd, loader, views, bbox = _setup(project)
+        s1, mr1 = _container(tmp_path / "sh.zarr", project.xml_path, bbox,
+                             ANISO_STEPS)
+        st = _fuse(sd, loader, views, bbox, s1, mr1, pyramid=True, devices=8)
+        # level 1 sub-blocks align with (16,16,8) chunks; level 2's (8,8,4)
+        # pieces would straddle them -> the prefix stops there
+        assert st.pyramid_levels == 1
+        assert st.pyramid_voxels > 0
+        _reread_levels(s1, mr1, start=1 + st.pyramid_levels)
+
+        s2, mr2 = _reread_reference(tmp_path, "sh_ref.zarr",
+                                    project.xml_path, bbox, ANISO_STEPS,
+                                    s1, mr1)
+        _levels_equal(s1, mr1, s2, mr2)
+
+    def test_sharded_ineligible_factors_fall_back_whole(self, project,
+                                                        tmp_path):
+        """Factors that do not divide the compute block produce NO epilogue
+        prefix; the reread fallback alone fills the pyramid."""
+        sd, loader, views, bbox = _setup(project)
+        steps = [[1, 1, 1], [3, 3, 3]]
+        s1, mr1 = _container(tmp_path / "odd.zarr", project.xml_path, bbox,
+                             steps)
+        st = _fuse(sd, loader, views, bbox, s1, mr1, pyramid=True, devices=8)
+        assert st.pyramid_levels == 0
+        assert st.pyramid_voxels == 0
+        _reread_levels(s1, mr1)
+        lvl = s1.open_dataset(mr1[1].dataset.strip("/")).read_full()
+        assert list(lvl.shape[:3]) == [int(v) for v in
+                                       mr1[1].dimensions[:3]]
+        assert lvl.std() > 0
+
+    def test_eligibility_rules(self, project, tmp_path):
+        sd, loader, views, bbox = _setup(project)
+        store, mr = _container(tmp_path / "elig.zarr", project.xml_path,
+                               bbox, ANISO_STEPS)
+        pyr = _pyramid(store, mr)
+        # compute block (32,32,8): level 1 (2,2,1) divides and aligns with
+        # the (16,16,8) chunks; level 2 (4,4,2) divides but its (8,8,4)
+        # piece straddles chunks -> prefix of 1
+        assert len(eligible_epilogue_levels(pyr, (32, 32, 8),
+                                            bbox.shape)) == 1
+        # a factor wider than the axis is never block-local
+        thin = [PyramidLevel(ds=pyr[0].ds, rel=(2, 2, 64),
+                             abs_factor=(2, 2, 64), dims=(43, 43, 1))]
+        assert eligible_epilogue_levels(thin, (32, 32, 64),
+                                        bbox.shape) == []
+
+
+class TestSingleDrain:
+    def test_one_full_res_d2h_pass_trace_counted(self, project, tmp_path):
+        """Tier-1 acceptance: with the epilogue on, exactly ONE full-res
+        pass crosses the wire under ``fusion.d2h`` (slab nbytes sum to the
+        volume exactly), the pyramid rides as ``fusion.epilogue.*``, and
+        total fusion D2H stays <= 1.2x the full-res-only drain."""
+        from bigstitcher_spark_tpu.observe import metrics
+
+        sd, loader, views, bbox = _setup(project)
+        steps = [[1, 1, 1], [2, 2, 2], [4, 4, 4]]
+        store, mr = _container(tmp_path / "drain.zarr", project.xml_path,
+                               bbox, steps)
+        trace.configure(buffer_bytes=8 << 20)
+        base = metrics.get_registry().snapshot()
+        st = _fuse(sd, loader, views, bbox, store, mr, pyramid=True,
+                   devices=1)
+        assert st.pyramid_levels == 2
+        delta = metrics.get_registry().snapshot_delta(base)
+        snap = trace.snapshot()
+
+        full_bytes = int(np.prod(bbox.shape)) * 2  # uint16
+        d2h = [e for e in snap if e["name"] == "fusion.d2h"
+               and e["ph"] == "B"]
+        assert sum(e["nbytes"] for e in d2h) == full_bytes
+        epi_d2h = [e for e in snap if e["name"] == "fusion.epilogue.d2h"
+                   and e["ph"] == "B"]
+        epi_bytes = sum(e["nbytes"] for e in epi_d2h)
+        assert 0 < epi_bytes <= 0.2 * full_bytes
+        assert any(e["name"] == "fusion.epilogue.write" for e in snap)
+        # the registry agrees with the trace: one full-res pass + pyramid
+        xfer = next(v for k, v in delta.items()
+                    if k.startswith("bst_xfer_d2h_bytes_total"))
+        assert xfer <= 1.2 * full_bytes
+        epi_counter = sum(v for k, v in delta.items()
+                          if k.startswith("bst_epilogue_d2h_bytes_total"))
+        assert epi_counter == epi_bytes
+
+    def test_cli_pyramid_skips_downsample_reread(self, project, tmp_path):
+        """End to end: ``affine-fusion --pyramid`` materializes every level
+        in the drain, marks them, and the downsample stage runs ZERO work
+        — no full-res container re-read. A later run WITHOUT --pyramid
+        revokes the marks so downsample recomputes."""
+        from bigstitcher_spark_tpu.cli.main import cli
+
+        out = str(tmp_path / "cli_fused.ome.zarr")
+        runner = CliRunner()
+        r = runner.invoke(cli, [
+            "create-fusion-container", "-x", project.xml_path, "-o", out,
+            "-s", "ZARR", "-d", "UINT16", "--blockSize", "16,16,8",
+            "--minIntensity", "0", "--maxIntensity", "65535",
+            "-ds", "1,1,1", "-ds", "2,2,2",
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        r = runner.invoke(cli, [
+            "affine-fusion", "-o", out, "--pyramid", "--devices", "1",
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        assert "epilogue: 1 pyramid level(s)" in r.output
+
+        store = ChunkStore.open(out)
+        mr = read_container_meta(store).mr_infos[0]
+        assert epilogue_written(store, mr[1].dataset, (0, 0))
+        lvl = store.open_dataset(mr[1].dataset.strip("/")).read_full()
+        assert lvl.std() > 0
+
+        # without --pyramid the marks are revoked and downsample recomputes
+        r = runner.invoke(cli, [
+            "affine-fusion", "-o", out, "--devices", "1",
+        ], catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        assert not epilogue_written(store, mr[1].dataset, (0, 0))
+        lvl2 = store.open_dataset(mr[1].dataset.strip("/")).read_full()
+        assert (lvl2 == lvl).all()   # reread path == epilogue path
+
+    def test_downsample_cmd_skip_existing(self, project, tmp_path):
+        """``bst downsample --skip-existing`` skips steps whose output
+        already exists with matching dims + factors."""
+        from bigstitcher_spark_tpu.cli.main import cli
+
+        sd, loader, views, bbox = _setup(project)
+        root = str(tmp_path / "plain.n5")
+        store = ChunkStore.create(root, StorageFormat.N5)
+        ds = store.create_dataset("vol/s0", bbox.shape, (16, 16, 8),
+                                  "uint16")
+        ds.write(np.random.default_rng(3).integers(
+            0, 1000, size=tuple(bbox.shape)).astype(np.uint16), (0, 0, 0))
+        runner = CliRunner()
+        args = ["downsample", "-i", root, "-di", "vol/s0",
+                "-ds", "2,2,2", "--skip-existing"]
+        r = runner.invoke(cli, args, catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        assert "skipped" not in r.output
+        first = store.open_dataset("vol/s1").read_full()
+        r = runner.invoke(cli, args, catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        assert "skipped" in r.output
+        assert (store.open_dataset("vol/s1").read_full() == first).all()
+
+
+class TestPerDeviceDirectWrites:
+    def test_driver_thread_writes_nothing_devices_own_disjoint_chunks(
+            self, project, tmp_path):
+        """Sharded fusion under --trace: every ``fusion.write`` span sits
+        on a device worker track (device-attributed, off the driver
+        thread), each device wrote only its own disjoint blocks, every
+        block was written exactly once, and the dataset's write-generation
+        advanced exactly once per write."""
+        from bigstitcher_spark_tpu.io import chunkcache
+
+        sd, loader, views, bbox = _setup(project)
+        store, mr = _container(tmp_path / "direct.zarr", project.xml_path,
+                               bbox, [[1, 1, 1]])
+        ds = store.open_dataset(mr[0].dataset.strip("/"))
+        gen0 = chunkcache.get_cache().generation(ds._cache_key())
+        driver_tid = threading.get_ident()
+        trace.configure(buffer_bytes=8 << 20)
+        st = fuse_volume(
+            sd, loader, views, ds, bbox, block_size=(16, 16, 8),
+            block_scale=(2, 2, 1), out_dtype="uint16", min_intensity=0.0,
+            max_intensity=65535.0, zarr_ct=(0, 0), devices=8)
+        snap = trace.snapshot()
+
+        writes = [e for e in snap if e["name"] == "fusion.write"
+                  and e["ph"] == "B"]
+        n_blocks = st.blocks - st.skipped_empty
+        assert len(writes) == n_blocks > 1
+        assert all(e.get("device") is not None for e in writes), \
+            "a fusion.write ran without device attribution"
+        assert all(e["tid"] != driver_tid for e in writes), \
+            "the driver thread performed a write"
+        per_dev: dict = {}
+        for e in writes:
+            per_dev.setdefault(e["device"], set()).add(tuple(e["item"]))
+        assert len(per_dev) > 1, "writes did not spread over devices"
+        all_items = [tuple(e["item"]) for e in writes]
+        assert len(set(all_items)) == len(all_items)  # disjoint ownership
+        # d2h also attributed per device
+        d2h = [e for e in snap if e["name"] == "mesh.d2h" and e["ph"] == "B"]
+        assert d2h and all(e.get("device") is not None for e in d2h)
+        # write-generations: one bump per write op, nothing lost or doubled
+        gen1 = chunkcache.get_cache().generation(ds._cache_key())
+        assert gen1 - gen0 == n_blocks
+
+    def test_hdf5_keeps_single_writer_driver_drain(self, project, tmp_path):
+        """h5py containers must keep the driver-drained single-writer path
+        — and still produce output identical to the zarr run."""
+        from bigstitcher_spark_tpu.io.chunkstore import Hdf5Store
+
+        sd, loader, views, bbox = _setup(project)
+        h5 = Hdf5Store(str(tmp_path / "direct.h5"))
+        ds = h5.create_dataset("fused", bbox.shape, (16, 16, 8), "uint16")
+        driver_tid = threading.get_ident()
+        trace.configure(buffer_bytes=8 << 20)
+        fuse_volume(sd, loader, views, ds, bbox, block_size=(16, 16, 8),
+                    block_scale=(2, 2, 1), out_dtype="uint16",
+                    min_intensity=0.0, max_intensity=65535.0, devices=8)
+        writes = [e for e in trace.snapshot()
+                  if e["name"] == "fusion.write" and e["ph"] == "B"]
+        assert writes
+        assert all(e.get("device") is None for e in writes)
+
+        store, mr = _container(tmp_path / "zref.zarr", project.xml_path,
+                               bbox, [[1, 1, 1]])
+        zds = store.open_dataset(mr[0].dataset.strip("/"))
+        fuse_volume(sd, loader, views, zds, bbox, block_size=(16, 16, 8),
+                    block_scale=(2, 2, 1), out_dtype="uint16",
+                    min_intensity=0.0, max_intensity=65535.0,
+                    zarr_ct=(0, 0), devices=8)
+        assert (ds.read_full()
+                == zds.read_full()[..., 0, 0]).all()
+        h5.close()
