@@ -33,6 +33,15 @@ Checks
     anywhere outside ``config.py``, and checks every name passed to
     ``config.get*()`` is declared in the registry.
 
+``env-mutation``
+    Bans MUTATING the process environment for ``BST_*`` names anywhere in
+    the package, ``config.py`` included (assignment, ``del``,
+    ``setdefault``/``pop``/``update``, ``os.putenv``). One process now
+    hosts many jobs (``bst serve``): an env write from one job's code path
+    leaks into every concurrent job and the daemon itself. Per-job
+    configuration goes through ``config.overrides()`` — a contextvars
+    layer the worker threads inherit — never the shared environment.
+
 ``metric-name``
     Every ``bst_*`` string literal in the package must be declared in
     ``observe/metric_names.py`` (a typo'd counter otherwise reports zero
@@ -619,6 +628,66 @@ def check_config_registry(files: list[FileCtx]) -> list[Finding]:
 
 
 # --------------------------------------------------------------------------
+# env-mutation
+# --------------------------------------------------------------------------
+
+_ENV_MUTATORS = {"os.environ.setdefault", "environ.setdefault",
+                 "os.environ.pop", "environ.pop",
+                 "os.environ.update", "environ.update",
+                 "os.putenv", "putenv"}
+
+
+def _bst_const(node: ast.AST) -> str | None:
+    if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and node.value.startswith("BST_")):
+        return node.value
+    return None
+
+
+def check_env_mutation(files: list[FileCtx]) -> list[Finding]:
+    """Flag every write to a ``BST_*`` process-environment name. Unlike
+    config-registry (read hygiene, config.py exempt) this check has no
+    exempt file: nothing in the package may mutate the shared env — the
+    override layer (config.overrides) is the per-job mechanism."""
+    out: list[Finding] = []
+    msg = ("mutating the {name} process environment leaks across daemon "
+           "jobs — use config.overrides() for per-job values")
+    for ctx in files:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                 ast.Delete)):
+                targets = (node.targets if isinstance(node, (ast.Assign,
+                                                             ast.Delete))
+                           else [node.target])
+                for t in targets:
+                    if (isinstance(t, ast.Subscript)
+                            and dotted(t.value) in _ENV_SUBSCRIPTS):
+                        name = _bst_const(t.slice)
+                        if name:
+                            out.append(ctx.finding(
+                                "env-mutation", node,
+                                msg.format(name=name)))
+            elif isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d not in _ENV_MUTATORS or not node.args:
+                    continue
+                # environ.update takes a dict of names; the others take
+                # the name first. setdefault/update/putenv WRITE;
+                # environ.pop only reads-and-removes, but removal is
+                # mutation too
+                if (isinstance(node.args[0], ast.Dict)
+                        and any(_bst_const(k) for k in node.args[0].keys)):
+                    out.append(ctx.finding(
+                        "env-mutation", node, msg.format(name="BST_*")))
+                else:
+                    name = _bst_const(node.args[0])
+                    if name:
+                        out.append(ctx.finding(
+                            "env-mutation", node, msg.format(name=name)))
+    return out
+
+
+# --------------------------------------------------------------------------
 # metric-name
 # --------------------------------------------------------------------------
 
@@ -774,6 +843,7 @@ ALL_CHECKS = {
     "host-sync": check_host_sync,
     "lock-discipline": check_lock_discipline,
     "config-registry": check_config_registry,
+    "env-mutation": check_env_mutation,
     "metric-name": check_metric_names,
     "span-name": check_span_names,
 }

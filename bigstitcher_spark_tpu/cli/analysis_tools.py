@@ -40,9 +40,11 @@ def lint_cmd(root, baseline_path, fail_on_new, show_all, update_baseline,
     Checks: host-sync (no hidden device round-trips in ops/ and models/),
     lock-discipline (guarded state mutated lock-free; inconsistent lock
     order), config-registry (no raw BST_* environment access outside
-    config.py), metric-name (every bst_* series declared once in
-    observe/metric_names.py). Suppress a single line with
-    `# bst-lint: off=<check>`."""
+    config.py), env-mutation (no BST_* environment WRITES anywhere — a
+    multi-job daemon shares one env; per-job values go through
+    config.overrides), metric-name / span-name (every bst_* series and
+    span literal declared once in observe/metric_names.py). Suppress a
+    single line with `# bst-lint: off=<check>`."""
     from ..analysis import (
         ALL_CHECKS,
         default_baseline_path,

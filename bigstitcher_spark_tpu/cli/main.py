@@ -15,6 +15,7 @@ from . import (
     fusion_tools,
     intensity_tools,
     resave_tools,
+    serve_tools,
     solver_tools,
     stitching_tools,
     telemetry_tools,
@@ -55,6 +56,10 @@ cli.add_command(telemetry_tools.telemetry_merge_cmd, "telemetry-merge")
 cli.add_command(telemetry_tools.trace_report_cmd, "trace-report")
 cli.add_command(analysis_tools.lint_cmd, "lint")
 cli.add_command(analysis_tools.config_cmd, "config")
+cli.add_command(serve_tools.serve_cmd, "serve")
+cli.add_command(serve_tools.submit_cmd, "submit")
+cli.add_command(serve_tools.jobs_cmd, "jobs")
+cli.add_command(serve_tools.cancel_cmd, "cancel")
 
 
 def main():

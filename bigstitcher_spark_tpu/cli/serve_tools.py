@@ -1,0 +1,196 @@
+"""CLI face of the serve daemon: ``bst serve`` / ``submit`` / ``jobs`` /
+``cancel``.
+
+The daemon owns the mesh and the caches; these commands are thin clients
+over its Unix-domain socket (BST_SERVE_SOCKET / --socket), so a pipeline
+script swaps ``bst affine-fusion ...`` for ``bst submit affine-fusion
+...`` and stops paying jax init + compile per stage."""
+
+from __future__ import annotations
+
+import json as _json
+import sys
+
+import click
+
+
+def _socket_opt(f):
+    return click.option("--socket", "socket_path", default=None,
+                        help="daemon Unix socket (default: "
+                             "BST_SERVE_SOCKET or the per-user temp-dir "
+                             "path)")(f)
+
+
+@click.command()
+@_socket_opt
+@click.option("--slots", type=int, default=None,
+              help="concurrent job slots (default: BST_SERVE_SLOTS); "
+                   "derived byte-window budgets split across slots")
+@click.option("--jobs-root", "jobs_root", default=None,
+              help="directory for per-job telemetry (events/manifest/"
+                   "output.log per job; default: <socket>-jobs)")
+@click.option("--idle-timeout", "idle_timeout", type=int, default=None,
+              help="exit after this many idle seconds "
+                   "(default: BST_SERVE_IDLE_TIMEOUT; 0 = never)")
+@click.option("--detach", is_flag=True, default=False,
+              help="start the daemon as a background process and return "
+                   "once it answers ping")
+@click.option("--stop", is_flag=True, default=False,
+              help="ask the daemon on --socket to drain and exit")
+@click.option("--status", is_flag=True, default=False,
+              help="ping the daemon and print its status")
+def serve_cmd(socket_path, slots, jobs_root, idle_timeout, detach, stop,
+              status):
+    """Run (or manage) the persistent stitching daemon.
+
+    The daemon owns the device mesh and every process-wide cache
+    (decoded-chunk LRU, HBM tile cache, compiled-fn buckets); jobs
+    submitted with `bst submit` execute in-process with per-job config /
+    telemetry / cancellation scoping, so repeat submissions hit warm
+    compile caches instead of paying cold start."""
+    from ..serve import client, daemon
+
+    if stop:
+        client.shutdown(socket_path, drain=True)
+        click.echo("serve: drain requested")
+        return
+    if status:
+        click.echo(_json.dumps(client.ping(socket_path), indent=1))
+        return
+    if detach:
+        pid = daemon.spawn_detached(socket_path, slots=slots,
+                                    jobs_root=jobs_root,
+                                    idle_timeout=idle_timeout)
+        click.echo(f"serve: daemon ready (pid {pid})")
+        return
+    daemon.run_foreground(socket_path, slots=slots, jobs_root=jobs_root,
+                          idle_timeout=idle_timeout)
+
+
+def _parse_sets(pairs) -> dict:
+    out = {}
+    for p in pairs:
+        if "=" not in p:
+            raise click.BadParameter(f"--set wants BST_NAME=value: {p!r}")
+        k, v = p.split("=", 1)
+        out[k.strip()] = v
+    return out
+
+
+@click.command(context_settings={"ignore_unknown_options": True})
+@_socket_opt
+@click.option("--priority", type=int, default=0,
+              help="higher runs first (strict)")
+@click.option("--share", default=None,
+              help="fair-share identity; submitters with less accumulated "
+                   "runtime go first within a priority band")
+@click.option("--set", "sets", multiple=True, metavar="BST_NAME=VALUE",
+              help="per-job config override (repeatable; any declared "
+                   "BST_* knob — the job sees this value, the daemon and "
+                   "other jobs do not)")
+@click.option("--cost", type=float, default=1.0,
+              help="relative cost for LPT slot placement")
+@click.option("--follow/--no-follow", default=True,
+              help="stream heartbeats and exit with the job's exit code "
+                   "(default) vs. return the job id immediately")
+@click.option("--quiet", is_flag=True, default=False,
+              help="suppress heartbeat lines (exit code only)")
+@click.argument("tool")
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
+def submit_cmd(socket_path, priority, share, sets, cost, follow, quiet,
+               tool, args):
+    """Submit TOOL [ARGS...] to the serve daemon.
+
+    Example: bst submit affine-fusion -o fused.ome.zarr"""
+    from ..serve import client
+
+    def on_event(rec):
+        if quiet:
+            return
+        t = rec.get("type", rec.get("event"))
+        if t == "stage.progress":
+            click.echo(f"[{rec.get('job')}] {rec.get('stage')}: "
+                       f"{rec.get('done')}/{rec.get('total')} "
+                       f"({rec.get('rate_per_s')}/s, "
+                       f"eta {rec.get('eta_s')}s)", err=True)
+        elif t == "log":
+            click.echo(f"[{rec.get('job')}] {rec.get('message')}", err=True)
+        elif t == "start":
+            click.echo(f"[{rec.get('job')}] started on slot "
+                       f"{rec.get('slot')}", err=True)
+
+    try:
+        resp = client.submit(
+            socket_path, tool, list(args), priority=priority, share=share,
+            overrides=_parse_sets(sets), cost=cost, follow=follow,
+            on_event=on_event)
+    except (OSError, RuntimeError) as e:
+        raise click.ClickException(
+            f"{e} — is a daemon running? start one with `bst serve`")
+    if not follow:
+        click.echo(resp.get("job", ""))
+        return
+    rc = resp.get("exit_code")
+    state = resp.get("state")
+    if rc is None:
+        # a job cancelled while still queued never ran, so it has no
+        # exit code — that is still NOT success for the submitter
+        rc = 0 if state == "done" else 130
+    click.echo(f"[{resp.get('job')}] {state} "
+               f"(exit {rc}, {resp.get('seconds')}s, "
+               f"warm compile hits: {resp.get('warm_compile_hits', 0)})",
+               err=True)
+    if rc:
+        sys.exit(int(rc))
+
+
+@click.command()
+@_socket_opt
+@click.option("--json", "as_json", is_flag=True,
+              help="machine-readable daemon status + job table")
+def jobs_cmd(socket_path, as_json):
+    """List the daemon's jobs (queued, running, finished) + cache warmth."""
+    from ..serve import client
+
+    try:
+        resp = client.list_jobs(socket_path)
+    except (OSError, RuntimeError) as e:
+        raise click.ClickException(
+            f"{e} — is a daemon running? start one with `bst serve`")
+    if as_json:
+        click.echo(_json.dumps(resp, indent=1))
+        return
+    d = resp["daemon"]
+    cc = d.get("chunk_cache", {})
+    cf = d.get("compiled_fn", {})
+    click.echo(f"daemon pid {d.get('pid')} uptime {d.get('uptime_s')}s "
+               f"slots {d.get('slots')} queued {d.get('queue_depth')} "
+               f"active {d.get('active')}")
+    click.echo(f"caches: {cc.get('entries', 0)} chunks "
+               f"({cc.get('bytes', 0)} B, {cc.get('hits', 0)} hits) | "
+               f"compiled-fn warm {cf.get('warm_hits', 0)} / "
+               f"cold {cf.get('cold_builds', 0)}")
+    for j in resp["jobs"]:
+        line = (f"{j['id']:>6}  {j['state']:<10} {j['tool']:<24} "
+                f"prio {j['priority']} share {j['share']} "
+                f"wait {j['wait_s']}s")
+        if "seconds" in j:
+            line += f" run {j['seconds']}s"
+        if j.get("exit_code") is not None:
+            line += f" exit {j['exit_code']}"
+        click.echo(line)
+
+
+@click.command()
+@_socket_opt
+@click.argument("job_id")
+def cancel_cmd(socket_path, job_id):
+    """Cancel a queued or running job (running jobs unwind at the work
+    loops' safe points; other jobs and the daemon are untouched)."""
+    from ..serve import client
+
+    try:
+        resp = client.cancel(socket_path, job_id)
+    except (OSError, RuntimeError) as e:
+        raise click.ClickException(str(e))
+    click.echo(f"{resp.get('job')}: {resp.get('state')}")

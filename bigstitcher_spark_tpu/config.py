@@ -15,14 +15,27 @@ environment behave the way the caller expects. Unparseable values fall
 back to the declared default (a typo'd budget must not crash a pod run
 mid-stage), matching the historical behavior of the inline reads.
 
+Above the environment sits a PER-CONTEXT override layer
+(:func:`overrides`): a ``contextvars``-scoped dict of raw knob strings
+consulted before ``os.environ``. This is how the ``bst serve`` daemon
+gives each resident job its own configuration — N concurrent jobs in one
+process cannot share a mutable ``os.environ`` (mutating it from a job
+leaks into every other job; the ``env-mutation`` lint check bans exactly
+that). Override values parse with the SAME rules as environment strings,
+and :mod:`utils.threads` carries the context into worker threads so a
+job's pools and device workers see the job's values, not the daemon's.
+
 ``bst config`` renders :func:`resolve` — every knob, its resolved value,
-and whether it came from the environment or the default — which is also
-what ``bst env`` embeds so diagnostics always show the full surface.
+and whether it came from an override, the environment or the default —
+which is also what ``bst env`` embeds so diagnostics always show the
+full surface.
 """
 
 from __future__ import annotations
 
+import contextvars
 import os
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any
 
@@ -147,6 +160,21 @@ _knob("BST_TRACE_PATH", "str", None,
       "trace-{process}.json in the telemetry dir when one is set, else "
       "./bst-trace.json.")
 
+# -- serve daemon ----------------------------------------------------------
+_knob("BST_SERVE_SOCKET", "str", None,
+      "Unix-domain socket path of the `bst serve` daemon (`bst submit` / "
+      "`bst jobs` / `bst cancel` connect here). Default: "
+      "bst-serve-<uid>.sock in the system temp dir.")
+_knob("BST_SERVE_SLOTS", "int", 2,
+      "Concurrent job slots of the `bst serve` daemon. Per-job byte-window "
+      "budgets (BST_INFLIGHT_BYTES / BST_PAIR_INFLIGHT_BYTES) split by this "
+      "count unless the job overrides them, so concurrent jobs share the "
+      "derived HBM windows instead of each claiming the whole budget.")
+_knob("BST_SERVE_IDLE_TIMEOUT", "int", 0,
+      "Seconds of no connections AND no jobs after which a `bst serve` "
+      "daemon exits on its own (0 = run until shutdown). CI smoke runs "
+      "set it so a crashed client can never leak a resident daemon.")
+
 # -- install wrappers ------------------------------------------------------
 _knob("BST_DEVICES", "int", None,
       "Virtual CPU mesh size (xla_force_host_platform_device_count) "
@@ -188,11 +216,65 @@ _knob("BST_BIG_TESTS", "bool", False,
       "matcher case).", consumer="tests")
 
 
+# -- per-context override layer --------------------------------------------
+# Raw knob strings layered OVER the environment for the current
+# contextvars context: the serve daemon's per-job configuration isolation
+# (each job reads its own values, no process-env mutation, worker threads
+# inherit via utils.threads). Values are stored as the same raw strings
+# the environment would carry, so parsing/fallback semantics are
+# identical; None masks an environment value back to the declared default.
+_OVERRIDES: contextvars.ContextVar[dict[str, str | None] | None] = \
+    contextvars.ContextVar("bst-config-overrides", default=None)
+
+
+def validate_overrides(mapping: dict) -> dict[str, str | None]:
+    """Normalize an override mapping: every key must be a declared knob
+    (raises KeyError otherwise — an undeclared override is a typo that
+    would otherwise silently do nothing), values become raw strings
+    (bools as the canonical "1"/"0"), None stays None (mask-to-default)."""
+    out: dict[str, str | None] = {}
+    for name, v in mapping.items():
+        if name not in KNOBS:
+            raise KeyError(f"override for undeclared knob {name!r} — "
+                           f"declare it in config.py first")
+        if v is None:
+            out[name] = None
+        elif isinstance(v, bool):
+            out[name] = "1" if v else "0"
+        else:
+            out[name] = str(v)
+    return out
+
+
+@contextmanager
+def overrides(mapping: dict | None):
+    """Layer ``mapping`` (knob name -> raw value) over the environment for
+    the duration of the ``with`` block in THIS context. Nested scopes
+    stack (inner wins); worker threads spawned through utils.threads see
+    the caller's layered view. An empty/None mapping is a no-op scope."""
+    cur = _OVERRIDES.get() or {}
+    token = _OVERRIDES.set({**cur, **validate_overrides(mapping or {})})
+    try:
+        yield
+    finally:
+        _OVERRIDES.reset(token)
+
+
+def current_overrides() -> dict[str, str | None]:
+    """The active override layer (flattened), for diagnostics and for
+    handing a job's configuration across process boundaries."""
+    return dict(_OVERRIDES.get() or {})
+
+
 def raw_value(name: str) -> str | None:
-    """The environment string for a DECLARED knob (KeyError otherwise);
-    unset and set-but-empty both read as None. The package's single
-    ``BST_*`` environment touchpoint."""
+    """The override-or-environment string for a DECLARED knob (KeyError
+    otherwise); unset and set-but-empty both read as None. The package's
+    single ``BST_*`` environment touchpoint."""
     knob = KNOBS[name]
+    ov = _OVERRIDES.get()
+    if ov is not None and knob.name in ov:
+        v = ov[knob.name]
+        return None if v is None or v == "" else v
     v = os.environ.get(knob.name)
     return None if v is None or v == "" else v
 
@@ -230,8 +312,9 @@ def get(name: str):
 
 
 def source(name: str) -> str:
-    """Where :func:`get` resolves ``name`` from right now: ``"env"`` or
-    ``"default"`` (unset, empty, or unparseable)."""
+    """Where :func:`get` resolves ``name`` from right now: ``"override"``
+    (a config.overrides scope is active for it), ``"env"`` or
+    ``"default"`` (unset, empty, masked, or unparseable)."""
     knob = KNOBS[name]
     raw = raw_value(name)
     if raw is None:
@@ -240,6 +323,9 @@ def source(name: str) -> str:
         _parse(knob, raw)
     except (ValueError, TypeError):
         return "default"
+    ov = _OVERRIDES.get()
+    if ov is not None and knob.name in ov:
+        return "override"
     return "env"
 
 
@@ -282,11 +368,13 @@ def resolve() -> list[dict]:
 def describe(verbose: bool = False) -> str:
     """Human-readable resolved-config dump (``bst config`` / ``bst env``).
 
-    One line per knob: name, resolved value, and ``(env)`` when the
-    environment overrides the default; ``verbose`` adds the docs."""
+    One line per knob: name, resolved value, and ``(env)`` /
+    ``(override)`` when something overrides the default; ``verbose`` adds
+    the docs."""
     lines = []
     for row in resolve():
-        mark = "  (env)" if row["source"] == "env" else ""
+        mark = ("  (env)" if row["source"] == "env"
+                else "  (override)" if row["source"] == "override" else "")
         lines.append(f"{row['name']}={row['value']}{mark}")
         if verbose:
             lines.append(f"    [{row['kind']}, default {row['default']!r}, "

@@ -176,8 +176,15 @@ class ChunkCache:
             self._update_gauges()
 
     def stats(self) -> dict:
+        """Residency + lifetime hit/miss totals — the `bst serve` daemon's
+        cache-warmth surface (`bst jobs` prints it so a client can see WHY
+        a repeat submit is cheap)."""
         with self._lock:
-            return {"entries": len(self._entries), "bytes": self._bytes}
+            resident = {"entries": len(self._entries), "bytes": self._bytes}
+        return {**resident,
+                "hits": _HITS.value, "misses": _MISSES.value,
+                "hit_bytes": _HIT_BYTES.value,
+                "evictions": _EVICTIONS.value}
 
     def _update_gauges(self) -> None:
         _CUR_BYTES.set(self._bytes)

@@ -624,6 +624,11 @@ def dispatch_composite(cp: CompositePlan, tiles, fusion_type, out_dtype,
     """Run the compiled composite program; returns the device-resident
     converted output (does not block)."""
     with_coeffs = cp.coeffs is not None
+    from ..parallel.mesh import record_compile_bucket
+
+    record_compile_bucket(("composite", cp.out_shape, cp.windows, cp.n_offs,
+                           cp.pad, fusion_type, out_dtype, masks,
+                           with_coeffs, cp.kinds))
     fuser = F.make_translation_composite(
         cp.out_shape, cp.windows, cp.n_offs, pad=cp.pad,
         fusion_type=fusion_type, out_dtype=out_dtype, masks=masks,
@@ -704,9 +709,8 @@ def _drain_device_volume(out, out_ds, zarr_ct, pyramid=(),
     reductions overlap the full-res compression + writes instead of
     stalling them. Returns the [(PyramidLevel, device array), ...] it
     materialized."""
-    from concurrent.futures import ThreadPoolExecutor
-
     from ..io.chunkstore import StorageFormat
+    from ..utils.threads import CtxThreadPool
 
     # ~8 MB slabs over ~8 streams measured best on the wire-limited link
     # (the knob's default); --prefetch/io_threads does not reach this
@@ -742,6 +746,11 @@ def _drain_device_volume(out, out_ds, zarr_ct, pyramid=(),
         jobs += lvl_jobs
 
     def drain(job):
+        from ..utils import cancel as _cancel
+
+        # per-slab safe point: a cancelled composite-path job stops
+        # fetching/writing between slabs (writes are chunk-atomic)
+        _cancel.check("fusion drain")
         ds, x0, slab, epi = job
         nb = int(slab.nbytes)   # known pre-fetch: device arrays size freely
         d2h_span = (profiling.span("fusion.epilogue.d2h", item=int(x0),
@@ -768,7 +777,7 @@ def _drain_device_volume(out, out_ds, zarr_ct, pyramid=(),
             if epi:
                 _EPI_WRITE_BYTES.inc(data.nbytes)
 
-    with ThreadPoolExecutor(max_workers=max(1, io_threads)) as pool:
+    with CtxThreadPool(max_workers=max(1, io_threads)) as pool:
         list(pool.map(drain, jobs))
     return levels
 
@@ -851,10 +860,9 @@ def _fuse_volume_sharded(
     driver-drained path. ``pyramid`` levels whose factors divide
     ``compute_block`` are produced per block as a kernel epilogue and
     written by the same per-device workers."""
-    from concurrent.futures import ThreadPoolExecutor
-
     from ..io.chunkstore import StorageFormat
     from ..parallel.mesh import make_mesh, make_sharded_fuser, run_sharded_batches
+    from ..utils.threads import CtxThreadPool
 
     grid = create_grid(bbox.shape, compute_block, compute_block)
     inside_offset = mask_offset if masks else (0.0, 0.0, 0.0)
@@ -898,7 +906,7 @@ def _fuse_volume_sharded(
     mi = np.float32(min_intensity)
     ma = np.float32(max_intensity)
     pwritten: dict[tuple, int] = {}
-    pool = ThreadPoolExecutor(max_workers=max(1, io_threads))
+    pool = CtxThreadPool(max_workers=max(1, io_threads))
     try:
         for key, items in sorted(buckets.items(), key=lambda kv: str(kv[0])):
             kernel, vb = key[0], key[-1]

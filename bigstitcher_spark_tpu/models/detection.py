@@ -13,11 +13,12 @@ over the union of overlap boxes — same output set, no dedup needed.
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
 import functools
 from dataclasses import dataclass, field
 
 import numpy as np
+
+from ..utils.threads import CtxThreadPool
 
 from .. import observe
 from ..io.dataset_io import ViewLoader, best_mipmap_level, mipmap_transform
@@ -318,7 +319,7 @@ def detect_interest_points(
             if params.min_intensity is None or params.max_intensity is None]
     ests: dict[ViewId, tuple[float, float]] = {}
     if need:  # estimation reads are independent -> overlap them
-        with ThreadPoolExecutor(max_workers=min(8, len(need))) as mpool:
+        with CtxThreadPool(max_workers=min(8, len(need))) as mpool:
             ests = dict(zip(need, mpool.map(
                 lambda v: _estimate_min_max(loader, v), need)))
     for v in views:
@@ -414,7 +415,7 @@ def detect_interest_points(
         order = np.lexsort(pts.T[::-1])
         job.result = (pts[order], vv[order])
 
-    pool = ThreadPoolExecutor(max_workers=8)
+    pool = CtxThreadPool(max_workers=8)
     try:
         # bucket by (det-res block shape, residual factors, input dtype):
         # one compiled kernel per bucket (median path pre-pools on host,

@@ -9,9 +9,9 @@ host IO on either side of the kernel.
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
-
 import numpy as np
+
+from ..utils.threads import CtxThreadPool
 
 from ..io.chunkstore import ChunkStore, Dataset, StorageFormat
 from ..io.container import MultiResolutionLevelInfo
@@ -115,7 +115,7 @@ def run_sharded_downsample(jobs, read_job, write_job, rel, devices=None,
             return (raw,)
         return (raw.astype(np.float32),)
 
-    pool = ThreadPoolExecutor(max_workers=max(1, io_threads))
+    pool = CtxThreadPool(max_workers=max(1, io_threads))
     try:
         for shp, items in sorted(buckets.items()):
             out_vox = int(np.prod([s // int(f) for s, f in zip(shp, rel)]))

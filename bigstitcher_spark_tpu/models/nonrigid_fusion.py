@@ -197,9 +197,9 @@ def fuse_nonrigid_volume(
         buckets.setdefault((pshape, vb), []).append(item)
 
     mi, ma = np.float32(min_intensity), np.float32(max_intensity)
-    from concurrent.futures import ThreadPoolExecutor
+    from ..utils.threads import CtxThreadPool
 
-    pool = ThreadPoolExecutor(max_workers=max(1, io_threads))
+    pool = CtxThreadPool(max_workers=max(1, io_threads))
     try:
         for (pshape, vb), items in sorted(buckets.items(),
                                           key=lambda kv: str(kv[0])):

@@ -482,9 +482,9 @@ def _refine_bucket(sd, jobs: list[_PairJob], shp, peaks,
                             // max(sat_bytes, 1)))
         workers = min(8, len(jobs), budget)
         if workers > 1:
-            from concurrent.futures import ThreadPoolExecutor
+            from ..utils.threads import CtxThreadPool
 
-            with ThreadPoolExecutor(max_workers=workers) as pool:
+            with CtxThreadPool(max_workers=workers) as pool:
                 list(pool.map(_refine, range(len(jobs))))
         else:
             for k in range(len(jobs)):

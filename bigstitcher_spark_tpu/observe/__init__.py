@@ -123,7 +123,10 @@ def finalize(tool: str | None = None, params: dict | None = None,
         error=error,
         spans=spans,
         metrics_delta=reg.snapshot_delta(_STATE["metrics_baseline"]),
-        stages=progress.records(),
+        # job-scoped stage records belong to their JobRun manifests, not
+        # the process-wide one (a serve daemon's own manifest would
+        # otherwise re-report every job's stages)
+        stages=[r for r in progress.records() if "job" not in r],
         events_file=os.path.basename(ev_path) if ev_path else None,
         trace_file=trace_path,
     )
@@ -133,3 +136,97 @@ def finalize(tool: str | None = None, params: dict | None = None,
     _STATE.update(dir=None, started_at=None, metrics_baseline=None,
                   enabled_profiling=False)
     return path
+
+
+class JobRun:
+    """Scoped telemetry for ONE job inside a long-lived process (the
+    ``bst serve`` daemon's per-job manifests).
+
+    Where :func:`configure`/:func:`finalize` own the whole process run,
+    a JobRun owns one job's slice of it: its own event-log sink
+    (``events-job-<label>-*.jsonl`` in its own directory, routed by the
+    job's context scope so concurrent jobs never interleave), its own
+    metric DELTAS (registry snapshot at open, delta at finalize — the
+    process registry stays shared, which is the point: warm caches are
+    visible as per-job hit deltas), its own span-count deltas, and its
+    own stage records (tagged by the event scope, popped at finalize).
+
+    Use as a context manager around the job's execution on the job's
+    thread — worker threads inherit the scope via utils.threads — then
+    call :meth:`finalize` for the manifest.
+    """
+
+    def __init__(self, label: str, directory: str, tool: str | None = None):
+        from .. import profiling
+
+        self.label = str(label)
+        self.dir = os.path.abspath(directory)
+        self.tool = tool
+        self.started_at = time.time()
+        events.open_job(self.label, self.dir)
+        self._metrics_baseline = metrics.get_registry().snapshot()
+        self._span_baseline = {
+            k: (s.count, s.total_s)
+            for k, s in profiling.get().stats().items()}
+        self._token = None
+        self._finalized = False
+
+    def __enter__(self):
+        self._token = events.activate_job(self.label)
+        events.emit("job.start", job=self.label, tool=self.tool,
+                    pid=os.getpid())
+        return self
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            events.deactivate_job(self._token)
+            self._token = None
+        return False
+
+    def finalize(self, status: str = "ok", error: str | None = None,
+                 params: dict | None = None,
+                 argv: list[str] | None = None) -> str | None:
+        """Write the job's manifest into its directory and close its event
+        sink. Idempotent; returns the manifest path."""
+        from .. import profiling
+
+        if self._finalized:
+            return None
+        self._finalized = True
+        seconds = time.time() - self.started_at
+        # the job.end record must land in the JOB's log regardless of
+        # which thread finalizes
+        token = events.activate_job(self.label)
+        try:
+            events.emit("job.end", job=self.label, status=status,
+                        seconds=round(seconds, 3), error=error)
+        finally:
+            events.deactivate_job(token)
+        ev_path = events.close_job(self.label)
+        spans = {}
+        for k, s in profiling.get().stats().items():
+            c0, t0 = self._span_baseline.get(k, (0, 0.0))
+            if s.count <= c0:
+                continue
+            # count/total are true deltas; min/max are process-lifetime
+            # aggregates (the profiler keeps no per-interval extrema)
+            spans[k] = {"count": s.count - c0,
+                        "total_s": round(s.total_s - t0, 3),
+                        "max_s": round(s.max_s, 3),
+                        "min_s": round(s.min_s, 3)}
+        reg = metrics.get_registry()
+        return manifest.write_manifest(
+            self.dir,
+            tool=self.tool,
+            argv=argv if argv is not None else [],
+            params=params,
+            world=events.world(),
+            started_at=self.started_at,
+            seconds=seconds,
+            status=status,
+            error=error,
+            spans=spans,
+            metrics_delta=reg.snapshot_delta(self._metrics_baseline),
+            stages=progress.take_records(self.label),
+            events_file=os.path.basename(ev_path) if ev_path else None,
+        )

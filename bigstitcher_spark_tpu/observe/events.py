@@ -1,4 +1,4 @@
-"""Append-only JSONL event log, one file per process.
+"""Append-only JSONL event log: one file per process, plus per-JOB files.
 
 The Spark reference's event log (spark.eventLog / the history server)
 re-expressed for the multi-host SPMD runtime: every process appends to its
@@ -6,21 +6,30 @@ own ``events-{process_index:05d}-of-{process_count:05d}.jsonl`` inside the
 run's telemetry directory, so pod runs never collide on a shared
 filesystem and ``bst telemetry-merge`` can fold the N files afterwards.
 
-Disabled (the default) the hot-path cost is one ``is None`` check per
+A long-lived ``bst serve`` daemon breaks the one-process-one-run
+assumption: two jobs in one process would interleave into one file named
+by process_index, and their manifests could no longer be separated. So
+emission is now SCOPED: a job opens its own sink (its own directory +
+``events-job-{label}-...jsonl`` file) and activates it on a context
+variable; ``emit`` routes to the active job's sink, falling back to the
+process-wide default sink (the classic ``--telemetry-dir`` behavior)
+outside any job scope. Worker threads inherit the scope through
+:mod:`utils.threads`. Sinks also carry subscriber callbacks — the serve
+daemon's live heartbeat stream to ``bst submit`` clients.
+
+Disabled (the default) the hot-path cost is one sink-resolution check per
 ``emit`` call; enabled, each event is one buffered+flushed JSON line.
 """
 
 from __future__ import annotations
 
+import contextvars
 import json
 import os
 import threading
 import time
 
 _lock = threading.RLock()
-_dir: str | None = None
-_file = None
-_path: str | None = None
 
 
 def world() -> tuple[int, int]:
@@ -42,23 +51,77 @@ def event_log_name(process_index: int, process_count: int) -> str:
     return f"events-{process_index:05d}-of-{process_count:05d}.jsonl"
 
 
+def job_event_log_name(job: str, process_index: int,
+                       process_count: int) -> str:
+    """Per-job log name: the job label keeps two daemon jobs out of each
+    other's files, the process pair keeps pod runs collision-free."""
+    return (f"events-job-{job}-"
+            f"{process_index:05d}-of-{process_count:05d}.jsonl")
+
+
+class _Sink:
+    """One JSONL output (lazily opened, append mode) + its subscribers."""
+
+    def __init__(self, directory: str, job: str | None = None):
+        self.dir = os.path.abspath(directory)
+        self.job = job
+        self.path: str | None = None
+        self._file = None
+        self.subscribers: list = []
+        os.makedirs(self.dir, exist_ok=True)
+
+    def write_locked(self, rec: dict) -> None:
+        if self._file is None:
+            pi, pc = world()
+            name = (event_log_name(pi, pc) if self.job is None
+                    else job_event_log_name(self.job, pi, pc))
+            self.path = os.path.join(self.dir, name)
+            self._file = open(self.path, "a", encoding="utf-8")
+        self._file.write(json.dumps(rec, default=_json_safe) + "\n")
+        self._file.flush()
+
+    def close_locked(self) -> str | None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        return self.path
+
+
+_default: _Sink | None = None
+_jobs: dict[str, _Sink] = {}
+_current: contextvars.ContextVar[str | None] = \
+    contextvars.ContextVar("bst-event-job", default=None)
+
+
+def _sink() -> _Sink | None:
+    """The sink the current context emits to: the active job's (when one
+    is open), else the process default."""
+    label = _current.get()
+    if label is not None:
+        s = _jobs.get(label)
+        if s is not None:
+            return s
+    return _default
+
+
 def configure(directory: str) -> None:
-    """Route subsequent ``emit`` calls to ``directory`` (file opened lazily
-    on first event, in append mode — reruns extend, never truncate)."""
-    global _dir, _file, _path
+    """Route subsequent default-scope ``emit`` calls to ``directory``
+    (file opened lazily on first event, in append mode — reruns extend,
+    never truncate)."""
+    global _default
     with _lock:
-        if _file is not None:
-            _file.close()
-        _dir, _file, _path = os.path.abspath(directory), None, None
-        os.makedirs(_dir, exist_ok=True)
+        if _default is not None:
+            _default.close_locked()
+        _default = _Sink(directory)
 
 
 def enabled() -> bool:
-    return _dir is not None
+    return _sink() is not None
 
 
 def path() -> str | None:
-    return _path
+    s = _sink()
+    return s.path if s is not None else None
 
 
 def _json_safe(o):
@@ -75,32 +138,91 @@ def _json_safe(o):
 
 
 def emit(etype: str, **fields) -> None:
-    """Append one event; no-op unless configured. ``None`` fields drop."""
-    if _dir is None:
+    """Append one event to the current scope's sink; no-op unless one is
+    configured. ``None`` fields drop. Subscribers run OUTSIDE the module
+    lock (a slow consumer — e.g. a serve client socket — must not stall
+    every other emitter)."""
+    s = _sink()
+    if s is None:
         return
+    rec = {"ts": round(time.time(), 6), "type": etype}
+    rec.update({k: v for k, v in fields.items() if v is not None})
     with _lock:
-        if _dir is None:
+        if s is not _sink():   # scope closed while we raced here
             return
-        global _file, _path
-        if _file is None:
-            pi, pc = world()
-            _path = os.path.join(_dir, event_log_name(pi, pc))
-            _file = open(_path, "a", encoding="utf-8")
-        rec = {"ts": round(time.time(), 6), "type": etype}
-        rec.update({k: v for k, v in fields.items() if v is not None})
-        _file.write(json.dumps(rec, default=_json_safe) + "\n")
-        _file.flush()
+        s.write_locked(rec)
+        subs = list(s.subscribers)
+    for cb in subs:
+        try:
+            cb(rec)
+        except Exception:
+            with _lock:
+                if cb in s.subscribers:
+                    s.subscribers.remove(cb)
 
 
 def close() -> str | None:
-    """Close the log and de-configure; returns the written path (if any)."""
-    global _dir, _file, _path
+    """Close the DEFAULT log and de-configure it; returns the written path
+    (if any). Job sinks close via :func:`close_job`."""
+    global _default
     with _lock:
-        p = _path
-        if _file is not None:
-            _file.close()
-        _dir, _file, _path = None, None, None
+        if _default is None:
+            return None
+        p = _default.close_locked()
+        _default = None
         return p
+
+
+# -- job scopes (the serve daemon's per-job telemetry) ----------------------
+
+def open_job(label: str, directory: str) -> None:
+    """Register a per-job sink writing into ``directory``. The scope only
+    routes events once :func:`activate_job` sets it on the context."""
+    with _lock:
+        old = _jobs.get(label)
+        if old is not None:
+            old.close_locked()
+        _jobs[label] = _Sink(directory, job=label)
+
+
+def close_job(label: str) -> str | None:
+    """Close and drop a job sink; returns its log path (if it wrote)."""
+    with _lock:
+        s = _jobs.pop(label, None)
+        return s.close_locked() if s is not None else None
+
+
+def activate_job(label: str):
+    """Make ``label`` the emitting scope for this context; returns a token
+    for :func:`deactivate_job`."""
+    return _current.set(label)
+
+
+def deactivate_job(token) -> None:
+    _current.reset(token)
+
+
+def current_job() -> str | None:
+    """The job label this context emits under, or None (default scope)."""
+    return _current.get()
+
+
+def subscribe(label: str, cb) -> bool:
+    """Attach ``cb(record)`` to a job sink's event stream (called after
+    each write, outside the log lock). False when no such sink is open."""
+    with _lock:
+        s = _jobs.get(label)
+        if s is None:
+            return False
+        s.subscribers.append(cb)
+        return True
+
+
+def unsubscribe(label: str, cb) -> None:
+    with _lock:
+        s = _jobs.get(label)
+        if s is not None and cb in s.subscribers:
+            s.subscribers.remove(cb)
 
 
 def iter_events(path: str):

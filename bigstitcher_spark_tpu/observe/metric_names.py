@@ -71,6 +71,24 @@ METRICS: dict[str, str] = {
     "bst_trace_events_total": "trace events recorded into the ring buffer",
     "bst_trace_events_dropped_total":
         "trace events dropped by ring-buffer overflow (newest events win)",
+    # compiled-fn bucket table (parallel/mesh.py + the composite factory
+    # call site in models/affine_fusion.py): whether a kernel request hit
+    # an already-built bucket (warm, no recompile) or built a new one
+    "bst_compiled_fn_warm_hits_total":
+        "kernel-bucket requests served by an already-built compiled fn",
+    "bst_compiled_fn_cold_builds_total":
+        "kernel-bucket requests that built (compiled) a new fn",
+    # serve daemon (serve/): queue + lifecycle + per-job cache warmth
+    "bst_serve_jobs_submitted_total": "jobs accepted by the serve daemon",
+    "bst_serve_jobs_completed_total":
+        "jobs finished, labeled by terminal status (ok/error/cancelled)",
+    "bst_serve_queue_depth": "jobs currently queued (not yet running)",
+    "bst_serve_active_jobs": "jobs currently executing",
+    "bst_serve_wait_seconds":
+        "queue wait (submit to start) histogram per job",
+    "bst_serve_compile_warm_hits_total":
+        "per-job warm compiled-fn bucket hits observed by the daemon "
+        "(the amortized-compile win of a resident process)",
 }
 
 # Every trace/profiling SPAN name, declared exactly once — the same
@@ -120,6 +138,11 @@ SPANS: dict[str, str] = {
     "io.read": "chunk-level container read (instant, bytes attributed)",
     "io.write": "chunk-level container write (instant, bytes attributed)",
     "barrier": "cross-host barrier wait (alignment anchor for merge)",
+    # serve daemon (serve/daemon.py)
+    "serve.job": "one submitted job's full execution on its slot",
+    "serve.submit": "a job was accepted into the queue (instant)",
+    "serve.cancel": "a cancel request was applied to a job (instant)",
+    "serve.shutdown": "the daemon began draining/shutting down (instant)",
 }
 
 

@@ -32,9 +32,27 @@ def records() -> list[dict]:
         return [dict(r) for r in _records]
 
 
+def take_records(job: str) -> list[dict]:
+    """Remove and return the stage records filed under ``job``'s event
+    scope (the serve daemon's per-job manifests): popping them keeps a
+    long-lived daemon's record list from growing per job, and keeps job
+    stages out of the daemon's own run manifest."""
+    with _rec_lock:
+        mine = [dict(r) for r in _records if r.get("job") == job]
+        _records[:] = [r for r in _records if r.get("job") != job]
+    for r in mine:
+        r.pop("job", None)
+    return mine
+
+
 def _append_record(rec: dict) -> None:
     if not events.enabled():
         return
+    # records filed inside a job's event scope carry the job label so a
+    # daemon can split concurrent jobs' stage tables into their manifests
+    job = events.current_job()
+    if job is not None:
+        rec = {**rec, "job": job}
     with _rec_lock:
         _records.append(rec)
 

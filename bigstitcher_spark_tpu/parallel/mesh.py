@@ -90,7 +90,43 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return _cached_mesh(n_devices)
 
 
-@functools.lru_cache(maxsize=64)
+# warm-vs-cold accounting for the compiled-fn bucket tables (this one and
+# the composite factory in ops.fusion): a resident `bst serve` process
+# amortizes compiles across jobs, and these counters are how that claim
+# becomes a recorded per-job delta instead of an anecdote
+_COMPILE_WARM = _metrics.counter("bst_compiled_fn_warm_hits_total")
+_COMPILE_COLD = _metrics.counter("bst_compiled_fn_cold_builds_total")
+# per-namespace LRU MIRRORS of the lru_caches being fronted, same
+# capacity and same request sequence (record runs right before the
+# factory call), so eviction here tracks eviction there — an unbounded
+# seen-set would keep reporting "warm" for signatures the bounded
+# lru_cache already dropped and must recompile
+_BUCKET_CAPS = {"sharded": 64, "composite": 32}
+_BUCKET_LRU: dict[str, "OrderedDict"] = {}
+_BUCKET_LOCK = threading.Lock()
+
+
+def record_compile_bucket(key) -> bool:
+    """Register one compiled-fn bucket request; returns True (and counts a
+    warm hit) when ``key`` is still resident in its factory's bounded
+    cache, else counts a cold build. ``key[0]`` names the factory
+    namespace. Shared by every lru_cache'd kernel-factory call site."""
+    from collections import OrderedDict
+
+    ns = key[0] if isinstance(key, tuple) and key \
+        and isinstance(key[0], str) else "default"
+    cap = _BUCKET_CAPS.get(ns, 64)
+    with _BUCKET_LOCK:
+        lru = _BUCKET_LRU.setdefault(ns, OrderedDict())
+        warm = key in lru
+        lru[key] = True
+        lru.move_to_end(key)
+        while len(lru) > cap:
+            lru.popitem(last=False)
+    (_COMPILE_WARM if warm else _COMPILE_COLD).inc()
+    return warm
+
+
 def make_sharded_fuser(
     mesh: Mesh,
     block_shape: tuple[int, int, int],
@@ -101,6 +137,27 @@ def make_sharded_fuser(
     masks: bool = False,
     pyramid: tuple = (),              # per-level relative factors: the
                                       # fused multiscale epilogue
+):
+    """The compiled-fn bucket table's front door: resolve (building if
+    needed) the sharded fuser for this signature and record whether the
+    request was warm. See :func:`_build_sharded_fuser` for the kernel
+    semantics."""
+    key = (mesh, block_shape, fusion_type, kernel, with_coeffs, out_dtype,
+           masks, pyramid)
+    record_compile_bucket(("sharded",) + key)
+    return _build_sharded_fuser(*key)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_sharded_fuser(
+    mesh: Mesh,
+    block_shape: tuple[int, int, int],
+    fusion_type: str = "AVG_BLEND",
+    kernel: str = "gather",
+    with_coeffs: bool = False,
+    out_dtype: str | None = None,
+    masks: bool = False,
+    pyramid: tuple = (),
 ):
     """Compile a fuser for a BATCH of blocks sharded over the mesh.
 
@@ -267,10 +324,12 @@ def run_sharded_batches(
         return
     drain_pool = None
     if device_drain:
-        from concurrent.futures import ThreadPoolExecutor
+        from ..utils.threads import CtxThreadPool
 
-        drain_pool = ThreadPoolExecutor(max_workers=max(1, n_dev),
-                                        thread_name_prefix="bst-dev-drain")
+        # context-propagating: drain workers read job-scoped config
+        # (write knobs) and emit into the job's event scope
+        drain_pool = CtxThreadPool(max_workers=max(1, n_dev),
+                                   thread_name_prefix="bst-dev-drain")
     window = InflightWindow()
     prefetched = {0: [pool.submit(build, it) for it in batches[0]]}
     dispatched: dict[int, tuple] = {}   # bi -> (outs, charged bytes)
@@ -351,6 +410,12 @@ def run_sharded_batches(
                                    for it in batches[nxt]]
 
     def process_batch(bi_batch):
+        from ..utils import cancel as _cancel
+
+        # between batches is the loop's safe point: a `bst cancel` poisons
+        # the NEXT dispatch, in-flight device work drains normally and the
+        # Cancelled unwinds through the retry layer without re-dispatch
+        _cancel.check(label)
         bi, batch = bi_batch
         if bi in completed:
             return

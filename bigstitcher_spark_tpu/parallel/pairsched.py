@@ -69,6 +69,8 @@ from typing import Any, Callable, Sequence
 from .. import config
 from ..observe import events, metrics as _metrics, progress as _progress
 from ..observe import trace as _trace
+from ..utils import cancel as _cancel
+from ..utils.threads import ctx_thread
 from .retry import RetryError
 
 # placement treats zero-cost tasks as infinitesimally heavy so they still
@@ -183,6 +185,11 @@ def _run_queue(queue, di, dispatch, drain, window, results, failures,
     on other devices)."""
     if drain is None:
         for t in queue:
+            if _cancel.cancelled():
+                # abandon the queue quietly: the caller's post-join cancel
+                # check raises ONE Cancelled for the stage instead of a
+                # missing-results RetryError per abandoned task
+                return
             try:
                 t0 = time.perf_counter()
                 with _trace.span("pair.dispatch", device=di,
@@ -230,6 +237,12 @@ def _run_queue(queue, di, dispatch, drain, window, results, failures,
                 window.release(t.nbytes)
 
     for t in queue:
+        if _cancel.cancelled():
+            # release what is pinned, then abandon (see above)
+            for group in (prev, seg):
+                for pt, _ in (group or ()):
+                    window.release(pt.nbytes)
+            return
         if seg and seg_bytes + t.nbytes > half:
             if prev is not None:
                 flush(prev)
@@ -344,14 +357,21 @@ def run_pair_tasks(
                            failures, meters, hb)
 
         threads = [
-            threading.Thread(target=worker, args=(di,), daemon=True,
-                             name=f"bst-pair-{stage}-{di}")
+            # ctx_thread: workers inherit the caller's job scope (config
+            # overrides size their windows, events land in the job's log,
+            # the cancel token can poison their queues)
+            ctx_thread(worker, (di,), name=f"bst-pair-{stage}-{di}")
             for di in range(n_dev) if queues[di]
         ]
         for th in threads:
             th.start()
         for th in threads:
             th.join()
+
+    # a cancelled stage abandons its queues above; raise the ONE Cancelled
+    # here (the existing re-dispatch path is the poison point: a cancelled
+    # task must never fail over to the next device)
+    _cancel.check(f"pairs-{stage}")
 
     # re-dispatch failed tasks on devices OTHER than the one observed
     # failing (single-device runs retry in place — there is nowhere else).
@@ -363,6 +383,7 @@ def run_pair_tasks(
         import jax
 
         for t, bad_di, err in list(failures):
+            _cancel.check(f"pairs-{stage}")
             last = err
             retried = False
             for k in range(1, max(n_dev, 2)):

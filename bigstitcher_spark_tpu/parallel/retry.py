@@ -15,10 +15,11 @@ from __future__ import annotations
 
 import time
 import traceback
-from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence, TypeVar
 
 from ..observe import events, metrics, progress, trace
+from ..utils.cancel import Cancelled
+from ..utils.threads import CtxThreadPool
 
 T = TypeVar("T")
 
@@ -71,12 +72,18 @@ def run_with_retry(
                     process(it)
                 hb.tick()
                 return None
+            except Cancelled:
+                # cancellation is not a block failure: resubmitting a
+                # cancelled item would defeat the cancel — unwind now
+                raise
             except Exception as e:  # noqa: BLE001 - any task failure is retryable
                 trace.instant("block.fail", stage=label, item=_item_key(it))
                 return (it, e)
 
         if threads > 1:
-            with ThreadPoolExecutor(max_workers=threads) as pool:
+            # context-propagating pool: items processed on workers keep the
+            # caller's job scope (config overrides, event sink, cancel token)
+            with CtxThreadPool(max_workers=threads) as pool:
                 failed = [r for r in pool.map(attempt, pending) if r is not None]
         else:
             failed = [r for r in map(attempt, pending) if r is not None]

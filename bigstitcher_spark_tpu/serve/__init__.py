@@ -1,0 +1,29 @@
+"""``bst serve`` — the persistent multi-job stitching daemon.
+
+Every stage used to be a one-shot CLI process paying jax init, compile
+warmup, chunk/tile-cache fill and device placement from zero — the
+opposite of a system that "serves heavy traffic" (ROADMAP Open item 1).
+This package is the Spark driver / history-server role (PAPER.md §L3/L5)
+rebuilt for resident accelerators: one long-lived process owns the device
+mesh and every process-wide cache (decoded-chunk LRU, HBM tile cache, the
+compiled-fn bucket tables), and the existing CLI tools become thin
+submitters over a local Unix-domain socket.
+
+- :mod:`.protocol` — the line-JSON request/stream framing both sides use;
+- :mod:`.jobs` — the job model and the priority + fair-share queue
+  (slot placement reuses ``pairsched``'s cost-weighted LPT);
+- :mod:`.daemon` — the resident server: socket accept loop, executor
+  slots, per-job config/telemetry/cancellation scoping, drain-on-SIGTERM;
+- :mod:`.client` — what ``bst submit`` / ``bst jobs`` / ``bst cancel``
+  call; streams job heartbeats back and returns the job's exit code.
+
+Per-job isolation is scoping, not process isolation: configuration rides
+:func:`config.overrides` (a contextvars layer — never ``os.environ``
+mutation, which the ``env-mutation`` lint check bans), telemetry rides
+:class:`observe.JobRun` (per-job event log + manifest + metric deltas),
+cancellation rides :mod:`utils.cancel`, and :mod:`utils.threads` carries
+all three into every worker thread a job spawns.
+"""
+
+from .jobs import Job, JobQueue  # noqa: F401
+from .protocol import default_socket_path  # noqa: F401

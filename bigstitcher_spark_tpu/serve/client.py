@@ -1,0 +1,84 @@
+"""Thin client side of the serve protocol (what ``bst submit`` / ``bst
+jobs`` / ``bst cancel`` call, and what tests drive in-process).
+
+Every function takes the socket path explicitly (None = the
+BST_SERVE_SOCKET / per-user default) and raises ``OSError`` when no
+daemon is listening — the CLI turns that into a friendly message."""
+
+from __future__ import annotations
+
+from . import protocol
+
+
+def _one_shot(socket_path: str | None, req: dict,
+              timeout: float | None = 30.0) -> dict:
+    s = protocol.connect(socket_path, timeout=timeout)
+    try:
+        f = s.makefile("rwb")
+        protocol.send_line(f, req)
+        resp = protocol.read_line(f)
+        if resp is None:
+            raise OSError("daemon closed the connection without replying")
+        if resp.get("event") == "error":
+            raise RuntimeError(resp.get("error", "daemon error"))
+        return resp
+    finally:
+        s.close()
+
+
+def ping(socket_path: str | None = None, timeout: float = 5.0) -> dict:
+    return _one_shot(socket_path, {"op": "ping"}, timeout=timeout)
+
+
+def list_jobs(socket_path: str | None = None) -> dict:
+    """{"daemon": {...status...}, "jobs": [...]}."""
+    resp = _one_shot(socket_path, {"op": "jobs"})
+    return {"daemon": resp.get("daemon", {}), "jobs": resp.get("jobs", [])}
+
+
+def cancel(socket_path: str | None, job_id: str) -> dict:
+    return _one_shot(socket_path, {"op": "cancel", "job": job_id})
+
+
+def shutdown(socket_path: str | None = None, drain: bool = True) -> dict:
+    return _one_shot(socket_path, {"op": "shutdown", "drain": drain})
+
+
+def submit(socket_path: str | None, tool: str, args: list[str],
+           *, priority: int = 0, share: str | None = None,
+           overrides: dict | None = None, cost: float = 1.0,
+           follow: bool = True, on_event=None,
+           timeout: float | None = None) -> dict:
+    """Submit one job. ``follow=True`` (default) blocks until the job
+    finishes, calling ``on_event(record)`` for every streamed heartbeat,
+    and returns the final ``done`` record (``exit_code``, ``state``,
+    ``warm_compile_hits``, ``telemetry_dir``). ``follow=False`` returns
+    the ``accepted`` record immediately."""
+    s = protocol.connect(socket_path, timeout=timeout)
+    try:
+        f = s.makefile("rwb")
+        protocol.send_line(f, {
+            "op": "submit", "tool": tool, "args": list(args),
+            "priority": priority, "share": share, "cost": cost,
+            "overrides": overrides or {}, "follow": follow,
+        })
+        first = protocol.read_line(f)
+        if first is None:
+            raise OSError("daemon closed the connection without replying")
+        if first.get("event") == "error":
+            raise RuntimeError(first.get("error", "daemon error"))
+        if not follow:
+            return first
+        job_id = first.get("job")
+        while True:
+            msg = protocol.read_line(f)
+            if msg is None:
+                raise OSError(f"daemon connection lost while following "
+                              f"job {job_id}")
+            if msg.get("event") == "done":
+                msg.setdefault("job", job_id)
+                return msg
+            if on_event is not None:
+                on_event(msg)
+    finally:
+        s.close()

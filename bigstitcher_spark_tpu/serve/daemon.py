@@ -1,0 +1,609 @@
+"""The resident ``bst serve`` daemon.
+
+One process owns jax, the device mesh, and every process-wide cache
+(decoded-chunk LRU, HBM tile cache, compiled-fn bucket tables); submitted
+jobs execute the SAME click commands the one-shot CLI runs, in-process on
+executor-slot threads, so a warm second job skips jax init, compile and
+cache fill entirely. Isolation is scoping:
+
+- **config** — each job runs under :func:`config.overrides` with its own
+  knob dict; unless the job sets them itself, the daemon splits the
+  derived in-flight byte budgets (``BST_INFLIGHT_BYTES``,
+  ``BST_PAIR_INFLIGHT_BYTES``) across the executor slots so concurrent
+  jobs SHARE the per-device windows instead of each claiming all of HBM;
+- **telemetry** — each job gets an :class:`observe.JobRun` (its own
+  ``events-job-*.jsonl`` + manifest + metric deltas in its own
+  directory) and its stdout routed to its own ``output.log``;
+- **cancellation** — each job carries a :class:`utils.cancel.CancelToken`
+  that the shared work loops poll at their safe points;
+- **crash isolation** — a job is one big try/except on its slot thread:
+  a failing job records FAILED and the mesh, caches and every other job
+  keep running.
+
+Lifecycle: SIGTERM/SIGINT (or the ``shutdown`` op) drains — the queue
+closes (queued jobs cancel), running jobs finish (or are cancelled when
+``drain=false``), then the accept loop exits and the socket unlinks.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import queue as _queuemod
+import signal
+import socket
+import sys
+import threading
+import time
+
+from .. import config, observe, profiling
+from ..observe import events, metrics as _metrics, trace as _trace
+from ..utils import cancel as _cancel
+from ..utils.threads import ctx_thread
+from . import protocol
+from .jobs import CANCELLED, DONE, FAILED, QUEUED, RUNNING, Job, JobQueue
+
+# tools a job may NOT be: the serve surface itself (a job submitting jobs
+# recurses), plus flags that would re-enter the process-global telemetry
+# lifecycle under the daemon's feet
+_BLOCKED_TOOLS = {"serve", "submit", "jobs", "cancel"}
+_BLOCKED_FLAGS = {"--telemetry-dir", "--profile", "--trace"}
+
+_WARM_HITS = _metrics.counter("bst_serve_compile_warm_hits_total")
+
+# events forwarded to following submit clients (everything else stays in
+# the job's JSONL only — a chatty fusion log must not flood the socket)
+_STREAMED_EVENTS = {"job.start", "job.end", "stage.start", "stage.progress",
+                    "stage.end", "log", "retry.round", "pair.redispatch"}
+
+
+class _StdoutRouter(io.TextIOBase):
+    """Routes ``sys.stdout`` writes to the emitting context's job log.
+
+    click.echo and the drivers' progress prints all write to the process
+    stdout; in a multi-job daemon that interleaves jobs. The router keys
+    on the ambient event scope (the same contextvar the event log routes
+    by, carried into worker threads by utils.threads) and appends to the
+    job's ``output.log``, falling back to the real stdout outside any job
+    scope."""
+
+    def __init__(self):
+        self._real = sys.__stdout__
+        self._lock = threading.Lock()
+        self._files: dict[str, object] = {}
+
+    def register(self, label: str, path: str) -> None:
+        """Open the job's log and make sure the router IS sys.stdout.
+
+        Installation happens here, per job, not at daemon start: anything
+        else that swaps sys.stdout while the daemon idles (pytest's
+        capture does, between test phases) would silently orphan an
+        install-once router. Re-checking at every job start self-heals —
+        whatever stream is current becomes the fallthrough target."""
+        with self._lock:
+            self._files[label] = open(path, "a", encoding="utf-8",
+                                      buffering=1)
+            if sys.stdout is not self:
+                self._real = sys.stdout
+                sys.stdout = self
+
+    def unregister(self, label: str) -> None:
+        with self._lock:
+            f = self._files.pop(label, None)
+            if not self._files and sys.stdout is self:
+                sys.stdout = self._real
+        if f is not None:
+            f.close()
+
+    def _target(self):
+        label = events.current_job()
+        if label is not None:
+            with self._lock:
+                f = self._files.get(label)
+            if f is not None:
+                return f
+        return self._real
+
+    def write(self, s) -> int:
+        return self._target().write(s)
+
+    def flush(self) -> None:
+        try:
+            self._target().flush()
+        except ValueError:
+            pass
+
+    @property
+    def encoding(self):
+        return getattr(self._real, "encoding", "utf-8")
+
+    def isatty(self):
+        return False
+
+
+class Daemon:
+    """The resident server. ``start()`` binds and spawns the accept loop
+    and executor slots; ``wait()`` blocks until shutdown completes (the
+    foreground ``bst serve`` mode); tests drive it in-process."""
+
+    def __init__(self, socket_path: str | None = None,
+                 slots: int | None = None,
+                 jobs_root: str | None = None,
+                 idle_timeout: float | None = None):
+        self.socket_path = socket_path or protocol.default_socket_path()
+        self.slots = slots if slots is not None else \
+            max(1, config.get_int("BST_SERVE_SLOTS") or 1)
+        self.jobs_root = os.path.abspath(
+            jobs_root or (self.socket_path + "-jobs"))
+        self.idle_timeout = (idle_timeout if idle_timeout is not None
+                             else config.get_int("BST_SERVE_IDLE_TIMEOUT")
+                             or 0)
+        self.queue = JobQueue(self.slots)
+        self.started_at = time.time()
+        self._sock: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._drained = threading.Event()
+        self._job_seq = 0
+        self._last_activity = time.monotonic()
+        self._router: _StdoutRouter | None = None
+        self._inflight_base: int | None = None
+        self._pair_base: int | None = None
+        self.device_info: dict = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Daemon":
+        os.makedirs(self.jobs_root, exist_ok=True)
+        self._warm_mesh()
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        with contextlib.suppress(OSError):
+            os.unlink(self.socket_path)
+        s.bind(self.socket_path)
+        s.listen(16)
+        s.settimeout(1.0)
+        self._sock = s
+        with self._lock:
+            self._router = _StdoutRouter()   # installs itself per job
+        for slot in range(self.slots):
+            th = ctx_thread(self._slot_loop, (slot,),
+                            name=f"bst-serve-slot-{slot}")
+            th.start()
+            self._threads.append(th)
+        th = ctx_thread(self._accept_loop, (), name="bst-serve-accept")
+        th.start()
+        self._threads.append(th)
+        observe.log(f"bst serve: listening on {self.socket_path} "
+                    f"({self.slots} slot(s), "
+                    f"{self.device_info.get('local_device_count', '?')} "
+                    f"device(s))", stage="serve")
+        return self
+
+    def _warm_mesh(self) -> None:
+        """Pay jax init + device placement ONCE, before accepting work;
+        derive the budget bases concurrent jobs split."""
+        from ..utils.devicemem import dispatch_budget_bytes, pair_budget_bytes
+
+        try:
+            import jax
+
+            devs = jax.local_devices()
+            self.device_info = {
+                "platform": devs[0].platform,
+                "local_device_count": len(devs),
+            }
+            self._inflight_base = dispatch_budget_bytes(devs[0])
+            self._pair_base = pair_budget_bytes(devs[0], 1)
+        except Exception as e:  # CPU-only hosts must still serve
+            self.device_info = {"error": repr(e)[:200]}
+            self._inflight_base = None
+            self._pair_base = None
+
+    def _on_signal(self, signum, frame) -> None:
+        self.shutdown(drain=True, wait=False)
+
+    def shutdown(self, drain: bool = True, wait: bool = True) -> None:
+        """Close the queue (queued jobs cancel); ``drain`` lets running
+        jobs finish, otherwise their tokens are set too. Idempotent."""
+        _trace.instant("serve.shutdown")
+        doomed = self.queue.close()
+        for job in doomed:
+            self._notify(job, {"event": "done", "job": job.id,
+                               "state": job.state, "exit_code": None})
+            job.waiters.clear()
+        if not drain:
+            for job in self.queue.jobs():
+                if job.state == RUNNING:
+                    job.token.cancel()
+        self._stop.set()
+        if wait:
+            self.wait()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the daemon fully stopped (socket closed, slots
+        joined)."""
+        return self._drained.wait(timeout)
+
+    def _finish_stop(self) -> None:
+        if self._sock is not None:
+            with contextlib.suppress(OSError):
+                self._sock.close()
+        with contextlib.suppress(OSError):
+            os.unlink(self.socket_path)
+        for th in self._threads:
+            if th is not threading.current_thread():
+                # no timeout: drain means running jobs FINISH (a cancel
+                # already poisons them when drain=False)
+                th.join()
+        with self._lock:
+            router = self._router
+            self._router = None
+        if router is not None and sys.stdout is router:
+            sys.stdout = router._real   # no job left it installed
+        self._drained.set()
+
+    # -- accept / connection handling ----------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                if (self.idle_timeout and self.queue.idle()
+                        and time.monotonic() - self._last_activity
+                        > self.idle_timeout):
+                    observe.log("bst serve: idle timeout, exiting",
+                                stage="serve")
+                    self.shutdown(drain=True, wait=False)
+                    break
+                continue
+            except OSError:
+                break
+            self._last_activity = time.monotonic()
+            th = ctx_thread(self._handle_conn, (conn,),
+                            name="bst-serve-conn")
+            th.start()
+        # the accept thread owns teardown so shutdown(wait=False) callers
+        # (signal handlers) never block inside the handler
+        self._finish_stop()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        f = conn.makefile("rwb")
+        try:
+            try:
+                req = protocol.read_line(f)
+            except (ValueError, OSError) as e:
+                protocol.send_line(f, {"event": "error",
+                                       "error": f"bad request: {e!r}"})
+                return
+            if not req:
+                return
+            op = req.get("op")
+            if op == "ping":
+                protocol.send_line(f, {
+                    "event": "pong", "pid": os.getpid(),
+                    "uptime_s": round(time.time() - self.started_at, 1),
+                    "device": self.device_info})
+            elif op == "jobs":
+                protocol.send_line(f, {"event": "jobs",
+                                       "daemon": self._status(),
+                                       "jobs": [j.describe() for j in
+                                                self.queue.jobs()]})
+            elif op == "cancel":
+                self._op_cancel(f, req)
+            elif op == "shutdown":
+                protocol.send_line(f, {"event": "shutdown",
+                                       "drain": bool(req.get("drain",
+                                                             True))})
+                self.shutdown(drain=bool(req.get("drain", True)),
+                              wait=False)
+            elif op == "submit":
+                self._op_submit(f, req)
+            else:
+                protocol.send_line(f, {"event": "error",
+                                       "error": f"unknown op {op!r}"})
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass   # client went away; jobs keep running
+        finally:
+            with contextlib.suppress(OSError):
+                f.close()
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def _status(self) -> dict:
+        from ..io.chunkcache import get_cache
+
+        return {
+            "pid": os.getpid(),
+            "socket": self.socket_path,
+            "slots": self.slots,
+            "uptime_s": round(time.time() - self.started_at, 1),
+            "queue_depth": self.queue.depth(),
+            "active": self.queue.active(),
+            "device": self.device_info,
+            "share_runtime_s": {k: round(v, 3) for k, v in
+                                self.queue.share_runtime().items()},
+            # warm-cache state: why the second submit is cheaper
+            "chunk_cache": get_cache().stats(),
+            "compiled_fn": {
+                "warm_hits": _metrics.counter(
+                    "bst_compiled_fn_warm_hits_total").value,
+                "cold_builds": _metrics.counter(
+                    "bst_compiled_fn_cold_builds_total").value,
+            },
+        }
+
+    def _op_cancel(self, f, req: dict) -> None:
+        job = self.queue.get(str(req.get("job", "")))
+        if job is None:
+            protocol.send_line(f, {"event": "error",
+                                   "error": f"no such job "
+                                            f"{req.get('job')!r}"})
+            return
+        self.queue.cancel(job.id)
+        _trace.instant("serve.cancel", item=job.id)
+        if job.state == CANCELLED and job.started_at is None:
+            # cancelled straight off the queue: no slot will ever notify
+            # this job's followers, so close their streams here
+            self._notify(job, {"event": "done", "job": job.id,
+                               "state": job.state, "exit_code": None})
+            job.waiters.clear()
+        protocol.send_line(f, {"event": "cancelled", "job": job.id,
+                               "state": job.state})
+
+    def _op_submit(self, f, req: dict) -> None:
+        from ..cli.main import cli as _cli
+
+        tool = str(req.get("tool", ""))
+        args = [str(a) for a in (req.get("args") or [])]
+        if tool not in _cli.commands or tool in _BLOCKED_TOOLS:
+            protocol.send_line(f, {"event": "error",
+                                   "error": f"unknown or unservable tool "
+                                            f"{tool!r}"})
+            return
+        # match both the split ("--flag", "v") and the fused ("--flag=v")
+        # spellings click accepts
+        bad = sorted({a for a in args
+                      if a.split("=", 1)[0] in _BLOCKED_FLAGS})
+        if bad:
+            protocol.send_line(f, {
+                "event": "error",
+                "error": f"{bad} are daemon-owned: per-job telemetry is "
+                         f"automatic (see the job directory)"})
+            return
+        try:
+            ov = config.validate_overrides(req.get("overrides") or {})
+        except KeyError as e:
+            protocol.send_line(f, {"event": "error", "error": str(e)})
+            return
+        with self._lock:
+            self._job_seq += 1
+            jid = f"j{self._job_seq:04d}"
+        job = Job(
+            id=jid, tool=tool, args=args,
+            priority=int(req.get("priority") or 0),
+            share=str(req.get("share") or "default"),
+            overrides=ov,
+            cost=float(req.get("cost") or 1.0),
+        )
+        job.telemetry_dir = os.path.join(self.jobs_root, jid)
+        follow = bool(req.get("follow", True))
+        waiter = None
+        if follow:
+            waiter = _queuemod.Queue()
+            job.waiters.append(waiter)
+        try:
+            self.queue.submit(job)
+        except RuntimeError as e:   # draining
+            protocol.send_line(f, {"event": "error", "error": str(e)})
+            return
+        _trace.instant("serve.submit", item=jid)
+        events.emit("serve.submit", job=jid, tool=tool, share=job.share,
+                    priority=job.priority)
+        protocol.send_line(f, {"event": "accepted", "job": jid,
+                               "telemetry_dir": job.telemetry_dir})
+        if not follow:
+            return
+        while True:
+            msg = waiter.get()
+            protocol.send_line(f, msg)
+            if msg.get("event") == "done":
+                return
+
+    # -- job execution -------------------------------------------------------
+
+    def _notify(self, job: Job, msg: dict) -> None:
+        for w in list(job.waiters):
+            w.put(msg)
+
+    def _job_budget_overrides(self, job: Job) -> dict[str, str]:
+        """The job's effective override layer: its own knobs win; below
+        them, the derived per-device byte windows split across the
+        executor slots so concurrent jobs share HBM instead of each
+        claiming the full budget (the window ledger's high-water gauge
+        stays <= the single-job budget)."""
+        ov = dict(job.overrides)
+        if self.slots > 1:
+            if self._inflight_base and "BST_INFLIGHT_BYTES" not in ov:
+                ov["BST_INFLIGHT_BYTES"] = str(
+                    max(1, self._inflight_base // self.slots))
+            if self._pair_base and "BST_PAIR_INFLIGHT_BYTES" not in ov:
+                ov["BST_PAIR_INFLIGHT_BYTES"] = str(
+                    max(1, self._pair_base // self.slots))
+        return ov
+
+    def _slot_loop(self, slot: int) -> None:
+        while True:
+            job = self.queue.take(slot, timeout=0.5)
+            if job is None:
+                if self._stop.is_set():
+                    return
+                continue
+            self._last_activity = time.monotonic()
+            self._run_job(slot, job)
+            self._last_activity = time.monotonic()
+
+    def _run_job(self, slot: int, job: Job) -> None:
+        """The crash-isolated job wrapper: whatever this raises is THIS
+        job's failure — the slot, the mesh and the caches live on. The
+        per-job SETUP (job dir, telemetry sink, output router) sits
+        inside the isolation too: a full disk must fail the job, not
+        kill the slot thread and wedge the queue."""
+        import click
+
+        from ..cli.main import cli as _cli
+
+        jobrun = None
+        router = None
+        warm0 = _metrics.counter("bst_compiled_fn_warm_hits_total").value
+        state, rc, error = DONE, 0, None
+        try:
+            os.makedirs(job.telemetry_dir, exist_ok=True)
+            jobrun = observe.JobRun(job.id, job.telemetry_dir,
+                                    tool=job.tool)
+            # live heartbeats: the job's event sink exists now, bridge its
+            # progress subset to every following client (the sink — and
+            # with it this subscription — is dropped by jobrun.finalize)
+            events.subscribe(job.id, _streaming_forwarder(job))
+            with self._lock:
+                router = self._router
+            if router is not None:
+                router.register(job.id, os.path.join(job.telemetry_dir,
+                                                     "output.log"))
+            with config.overrides(self._job_budget_overrides(job)), \
+                    _cancel.scope(job.token), jobrun:
+                self._notify(job, {"event": "start", "job": job.id,
+                                   "slot": slot})
+                with profiling.span("serve.job", stage=job.tool,
+                                    item=job.id):
+                    _cli(args=[job.tool, *job.args], prog_name="bst",
+                         standalone_mode=False)
+        except _cancel.Cancelled:
+            state, rc, error = CANCELLED, 130, "cancelled"
+        except click.exceptions.Exit as e:
+            rc = int(e.exit_code or 0)
+            state = DONE if rc == 0 else FAILED
+        except SystemExit as e:   # a tool calling sys.exit stays one job
+            rc = int(e.code) if isinstance(e.code, int) else 1
+            state = DONE if rc == 0 else FAILED
+        except click.ClickException as e:
+            state, rc, error = FAILED, e.exit_code or 1, e.format_message()
+        except BaseException as e:  # noqa: BLE001 — crash isolation
+            state, rc, error = FAILED, 1, repr(e)[:500]
+        if job.token.cancelled and state != CANCELLED:
+            # token set but the job finished first: report what happened
+            state = state if state == DONE else CANCELLED
+        job.warm_compile_hits = int(
+            _metrics.counter("bst_compiled_fn_warm_hits_total").value
+            - warm0)
+        _WARM_HITS.inc(job.warm_compile_hits)
+        try:
+            if jobrun is None:
+                raise RuntimeError("job setup failed before telemetry")
+            jobrun.finalize(
+                status={DONE: "ok", CANCELLED: "cancelled"}.get(state,
+                                                                "error"),
+                error=error,
+                params={"tool": job.tool, "args": job.args,
+                        "overrides": job.overrides,
+                        "priority": job.priority, "share": job.share,
+                        "slot": slot,
+                        "warm_compile_hits": job.warm_compile_hits})
+        except Exception:   # manifest IO must not flip the job's outcome
+            pass
+        if router is not None:
+            router.unregister(job.id)
+        self.queue.finish(job, state, exit_code=rc, error=error)
+        self._notify(job, {"event": "done", "job": job.id, "state": state,
+                           "exit_code": rc, "error": error,
+                           "seconds": job.describe().get("seconds"),
+                           "warm_compile_hits": job.warm_compile_hits,
+                           "telemetry_dir": job.telemetry_dir})
+        job.waiters.clear()   # done delivered; drop follower queues
+
+
+def _streaming_forwarder(job: Job):
+    """events->waiters bridge: forwards the heartbeat subset of a job's
+    event stream to every following client."""
+    def cb(rec: dict) -> None:
+        if rec.get("type") in _STREAMED_EVENTS:
+            for w in list(job.waiters):
+                w.put({"event": "job-event", "job": job.id, **rec})
+
+    return cb
+
+
+def run_foreground(socket_path: str | None = None, slots: int | None = None,
+                   jobs_root: str | None = None,
+                   idle_timeout: float | None = None) -> int:
+    """``bst serve`` without --detach: start, block until shutdown.
+
+    Signal handling lives HERE, not in Daemon.start(): only the
+    foreground CLI owns the process (and the main thread signal.signal
+    requires) — an in-process daemon (tests, bench) must never hijack
+    its host's SIGINT/SIGTERM. Previous handlers are restored on exit."""
+    d = Daemon(socket_path, slots=slots, jobs_root=jobs_root,
+               idle_timeout=idle_timeout)
+    d.start()
+    prev = {}
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev[sig] = signal.signal(sig, d._on_signal)
+    try:
+        while not d.wait(timeout=0.5):
+            pass
+    except KeyboardInterrupt:
+        d.shutdown(drain=True, wait=True)
+    finally:
+        for sig, h in prev.items():
+            signal.signal(sig, h)
+    return 0
+
+
+def spawn_detached(socket_path: str | None = None, slots: int | None = None,
+                   jobs_root: str | None = None,
+                   idle_timeout: float | None = None,
+                   ready_timeout: float = 180.0) -> int:
+    """``bst serve --detach``: fork a daemon subprocess, wait until its
+    socket answers ping, return its pid."""
+    import subprocess
+
+    from . import client
+
+    path = socket_path or protocol.default_socket_path()
+    # the daemon inherits the caller's cwd (so the job's relative paths
+    # resolve the same way), which need not be the package checkout —
+    # put wherever THIS package imports from on the child's path
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else pkg_root)
+    args = [sys.executable, "-m", "bigstitcher_spark_tpu.cli.main",
+            "serve", "--socket", path]
+    if slots is not None:
+        args += ["--slots", str(slots)]
+    if jobs_root is not None:
+        args += ["--jobs-root", jobs_root]
+    if idle_timeout is not None:
+        args += ["--idle-timeout", str(int(idle_timeout))]
+    log_path = path + ".log"
+    with open(log_path, "ab") as logf:
+        proc = subprocess.Popen(args, stdout=logf, stderr=logf, env=env,
+                                start_new_session=True)
+    deadline = time.monotonic() + ready_timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"serve daemon exited rc={proc.returncode} before ready "
+                f"(log: {log_path})")
+        try:
+            client.ping(path, timeout=2.0)
+            return proc.pid
+        except (OSError, ValueError):
+            time.sleep(0.2)
+    raise TimeoutError(f"serve daemon not ready after {ready_timeout}s "
+                       f"(log: {log_path})")

@@ -1,0 +1,271 @@
+"""Job model and the daemon's priority + fair-share queue.
+
+Scheduling is three-layered, cheapest concern last:
+
+1. **Priority** — higher ``priority`` strictly first (an operator's
+   interactive fusion beats a batch re-registration sweep).
+2. **Fair share** — within a priority band, the submitter (``share``)
+   with the least accumulated runtime goes first, so one chatty client
+   cannot starve the others (the Spark fair-scheduler pool role).
+3. **LPT slot placement** — the ordered backlog is spread over the
+   executor slots with :func:`pairsched.assign_tasks`, the same
+   cost-weighted greedy placement the pair stages use on devices: the
+   heaviest queued job lands on the least-loaded slot, bounding slot
+   imbalance by one job's cost. A slot whose plan is empty steals the
+   head of the ordered backlog rather than idling.
+
+Jobs carry their config override dict (resolved per job by the daemon
+through :func:`config.overrides`, never the process environment) and
+their :class:`utils.cancel.CancelToken`; cancelling a QUEUED job is a
+pure state flip, cancelling a RUNNING one sets the token and lets the
+work loops' poison points unwind it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..observe import metrics as _metrics
+from ..parallel.pairsched import PairTask, assign_tasks
+from ..utils.cancel import CancelToken
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+
+_SUBMITTED = _metrics.counter("bst_serve_jobs_submitted_total")
+_DEPTH = _metrics.gauge("bst_serve_queue_depth")
+_ACTIVE = _metrics.gauge("bst_serve_active_jobs")
+_WAIT = _metrics.histogram("bst_serve_wait_seconds")
+
+# terminal-job history kept for `bst jobs`: a resident daemon serving a
+# steady stream must not grow its registry (or its one-line `jobs`
+# response) without bound — oldest finished jobs age out past this
+MAX_FINISHED_JOBS = 200
+
+
+@dataclass
+class Job:
+    """One submitted tool invocation and its lifecycle record."""
+
+    id: str
+    tool: str
+    args: list[str]
+    priority: int = 0
+    share: str = "default"
+    overrides: dict[str, str] = field(default_factory=dict)
+    cost: float = 1.0            # relative placement weight (LPT)
+    state: str = QUEUED
+    submitted_at: float = field(default_factory=time.time)
+    started_at: float | None = None
+    finished_at: float | None = None
+    exit_code: int | None = None
+    error: str | None = None
+    seq: int = 0                 # FIFO tiebreak within a share
+    slot: int | None = None
+    telemetry_dir: str | None = None
+    warm_compile_hits: int = 0
+    token: CancelToken = field(default_factory=CancelToken)
+    waiters: list = field(default_factory=list)   # queue.Queue per client
+
+    def describe(self) -> dict[str, Any]:
+        now = time.time()
+        d = {
+            "id": self.id,
+            "tool": self.tool,
+            "args": list(self.args),
+            "priority": self.priority,
+            "share": self.share,
+            "state": self.state,
+            "submitted_at": round(self.submitted_at, 3),
+            "wait_s": round((self.started_at or now) - self.submitted_at, 3),
+        }
+        if self.overrides:
+            d["overrides"] = dict(self.overrides)
+        if self.started_at is not None:
+            d["seconds"] = round((self.finished_at or now)
+                                 - self.started_at, 3)
+        if self.slot is not None:
+            d["slot"] = self.slot
+        if self.exit_code is not None:
+            d["exit_code"] = self.exit_code
+        if self.error:
+            d["error"] = self.error
+        if self.telemetry_dir:
+            d["telemetry_dir"] = self.telemetry_dir
+        if self.warm_compile_hits:
+            d["warm_compile_hits"] = self.warm_compile_hits
+        return d
+
+
+class JobQueue:
+    """Thread-safe job registry + scheduler for N executor slots."""
+
+    def __init__(self, slots: int = 1):
+        self.slots = max(1, int(slots))
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._jobs: dict[str, Job] = {}
+        self._order: list[str] = []        # ids still QUEUED, FIFO
+        self._share_runtime: dict[str, float] = {}
+        self._seq = 0
+        self._closed = False
+
+    # -- submission / lookup ------------------------------------------------
+
+    def submit(self, job: Job) -> Job:
+        with self._nonempty:
+            if self._closed:
+                raise RuntimeError("daemon is draining: not accepting jobs")
+            self._seq += 1
+            job.seq = self._seq
+            self._jobs[job.id] = job
+            self._order.append(job.id)
+            _SUBMITTED.inc()
+            _DEPTH.set(len(self._order))
+            self._nonempty.notify_all()
+        return job
+
+    def get(self, job_id: str) -> Job | None:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def jobs(self) -> list[Job]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._order)
+
+    def active(self) -> int:
+        with self._lock:
+            return sum(1 for j in self._jobs.values() if j.state == RUNNING)
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._order and not any(
+                j.state == RUNNING for j in self._jobs.values())
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _ordered_backlog_locked(self) -> list[Job]:
+        backlog = [self._jobs[i] for i in self._order]
+        return sorted(backlog, key=lambda j: (
+            -j.priority,
+            self._share_runtime.get(j.share, 0.0),
+            j.seq))
+
+    def plan(self) -> list[list[str]]:
+        """Current backlog spread over the slots: the priority/fair-share
+        order feeds pairsched's cost-weighted LPT placement (heaviest job
+        -> least-loaded slot, deterministic). Advisory — ``take`` replans
+        on every pull, so the plan tracks a changing backlog."""
+        with self._lock:
+            backlog = self._ordered_backlog_locked()
+            bins = assign_tasks(
+                [PairTask(index=i, cost=max(j.cost, 0.0), tag=j.id)
+                 for i, j in enumerate(backlog)], self.slots)
+            return [[t.tag for t in b] for b in bins]
+
+    def take(self, slot_id: int, timeout: float | None = None) -> Job | None:
+        """Block until a job is available for ``slot_id`` (its LPT plan
+        entry first, else the backlog head), mark it RUNNING and return
+        it; None on timeout or when the queue closed empty."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._nonempty:
+            while True:
+                if self._order:
+                    backlog = self._ordered_backlog_locked()
+                    bins = assign_tasks(
+                        [PairTask(index=i, cost=max(j.cost, 0.0), tag=j.id)
+                         for i, j in enumerate(backlog)], self.slots)
+                    mine = bins[slot_id % self.slots]
+                    job_id = mine[0].tag if mine else backlog[0].id
+                    job = self._jobs[job_id]
+                    self._order.remove(job_id)
+                    job.state = RUNNING
+                    job.slot = slot_id
+                    job.started_at = time.time()
+                    _DEPTH.set(len(self._order))
+                    _ACTIVE.inc(1)
+                    _WAIT.observe(job.started_at - job.submitted_at)
+                    return job
+                if self._closed:
+                    return None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._nonempty.wait(remaining)
+                else:
+                    self._nonempty.wait()
+
+    def finish(self, job: Job, state: str, exit_code: int | None = None,
+               error: str | None = None) -> None:
+        with self._nonempty:
+            job.state = state
+            job.exit_code = exit_code
+            job.error = error
+            job.finished_at = time.time()
+            if job.started_at is not None:
+                self._share_runtime[job.share] = (
+                    self._share_runtime.get(job.share, 0.0)
+                    + (job.finished_at - job.started_at))
+                _ACTIVE.inc(-1)
+            _metrics.counter("bst_serve_jobs_completed_total",
+                             status=state).inc()
+            self._prune_locked()
+            self._nonempty.notify_all()
+
+    def _prune_locked(self) -> None:
+        terminal = [i for i, j in self._jobs.items()
+                    if j.state in (DONE, FAILED, CANCELLED)]
+        for jid in terminal[:max(0, len(terminal) - MAX_FINISHED_JOBS)]:
+            del self._jobs[jid]   # dict order == submission order
+
+    def cancel(self, job_id: str) -> Job | None:
+        """Cancel a job: queued -> terminal CANCELLED immediately; running
+        -> set its token (the work loops unwind at their poison points).
+        Returns the job, or None when unknown."""
+        with self._nonempty:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return None
+            job.token.cancel()
+            if job.state == QUEUED:
+                self._order.remove(job_id)
+                job.state = CANCELLED
+                job.finished_at = time.time()
+                _DEPTH.set(len(self._order))
+                _metrics.counter("bst_serve_jobs_completed_total",
+                                 status=CANCELLED).inc()
+                self._nonempty.notify_all()
+            return job
+
+    def close(self) -> list[Job]:
+        """Stop accepting; cancel everything still QUEUED (drain keeps the
+        RUNNING jobs). Returns the jobs cancelled off the queue."""
+        with self._nonempty:
+            self._closed = True
+            doomed = [self._jobs[i] for i in self._order]
+            self._order.clear()
+            for job in doomed:
+                job.token.cancel()
+                job.state = CANCELLED
+                job.finished_at = time.time()
+                _metrics.counter("bst_serve_jobs_completed_total",
+                                 status=CANCELLED).inc()
+            _DEPTH.set(0)
+            self._nonempty.notify_all()
+            return doomed
+
+    def share_runtime(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._share_runtime)

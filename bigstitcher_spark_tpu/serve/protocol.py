@@ -1,0 +1,70 @@
+"""Line-JSON framing over the serve daemon's Unix-domain socket.
+
+One request object per connection, then a stream of response objects —
+newline-delimited JSON, UTF-8, one object per line (the same framing as
+the JSONL event log, so a response stream is greppable/replayable with
+the same tooling). The final object of every stream has ``"event":
+"done"`` (or ``"error"``); ``bst submit --follow`` renders everything in
+between as live heartbeats.
+
+Requests::
+
+    {"op": "submit", "tool": "...", "args": [...], "priority": 0,
+     "share": "...", "overrides": {"BST_X": "..."}, "cost": 1.0,
+     "follow": true}
+    {"op": "jobs"}            {"op": "cancel", "job": "..."}
+    {"op": "shutdown", "drain": true}        {"op": "ping"}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import tempfile
+
+# requests and response lines are small control messages; a line larger
+# than this is a protocol violation, not data
+MAX_LINE = 1 << 20
+
+
+def default_socket_path() -> str:
+    """BST_SERVE_SOCKET, else a per-user path in the system temp dir (the
+    uid keeps multi-user hosts from colliding on one socket)."""
+    from .. import config
+
+    p = config.get_str("BST_SERVE_SOCKET")
+    if p:
+        return p
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(), f"bst-serve-{uid}.sock")
+
+
+def send_line(sock_or_file, obj: dict) -> None:
+    data = (json.dumps(obj) + "\n").encode("utf-8")
+    if hasattr(sock_or_file, "sendall"):
+        sock_or_file.sendall(data)
+    else:
+        sock_or_file.write(data)
+        sock_or_file.flush()
+
+
+def read_line(f) -> dict | None:
+    """One framed object from a socket makefile; None on EOF."""
+    line = f.readline(MAX_LINE)
+    if not line:
+        return None
+    line = line.strip()
+    if not line:
+        return {}
+    return json.loads(line)
+
+
+def connect(socket_path: str | None = None,
+            timeout: float | None = None) -> socket.socket:
+    path = socket_path or default_socket_path()
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    if timeout is not None:
+        s.settimeout(timeout)
+    s.connect(path)
+    return s

@@ -1,0 +1,75 @@
+"""Cooperative job cancellation.
+
+A long-lived ``bst serve`` daemon must be able to stop ONE in-flight job
+without touching the others or the device mesh: killing threads is not a
+thing, and abandoning a dispatch loop mid-run leaks in-flight windows and
+half-written state. Instead a :class:`CancelToken` travels with the job
+in a context variable (propagated into worker threads/pools by
+:mod:`utils.threads`), and the shared work loops — the retry layer, the
+sharded batch loop, the pair scheduler — poll :func:`check` at their
+natural safe points (between work items, never inside a device call).
+
+Raising :class:`Cancelled` unwinds through the loops' normal error paths
+with one crucial exception: it is NEVER retried or re-dispatched — a
+cancelled task failing over to the next device would turn cancellation
+into a tour of the mesh.
+
+Outside any token scope every call here is a no-op (one contextvar read),
+so the one-shot CLI tools pay nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+
+
+class Cancelled(RuntimeError):
+    """The current job's cancel token was set; unwind, don't retry."""
+
+
+class CancelToken:
+    """One job's cancellation flag (set once, never cleared)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+
+    def cancel(self) -> None:
+        self._event.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+
+_current: contextvars.ContextVar[CancelToken | None] = \
+    contextvars.ContextVar("bst-cancel-token", default=None)
+
+
+def current() -> CancelToken | None:
+    return _current.get()
+
+
+@contextlib.contextmanager
+def scope(token: CancelToken):
+    """Make ``token`` the ambient cancel token for this context (and, via
+    utils.threads, every worker spawned under it)."""
+    tok = _current.set(token)
+    try:
+        yield token
+    finally:
+        _current.reset(tok)
+
+
+def cancelled() -> bool:
+    """Whether the ambient token (if any) has been cancelled."""
+    t = _current.get()
+    return t is not None and t.cancelled
+
+
+def check(where: str | None = None) -> None:
+    """Raise :class:`Cancelled` when the ambient token is set; no-op
+    otherwise (and always outside any token scope)."""
+    if cancelled():
+        raise Cancelled(f"job cancelled{f' at {where}' if where else ''}")

@@ -1,0 +1,54 @@
+"""Context-propagating thread primitives.
+
+Python threads start from an EMPTY ``contextvars`` context, so every
+ambient this package scopes through context variables — per-job config
+overrides (:func:`config.overrides`), the per-job event-log scope
+(:mod:`observe.events`), the cooperative cancellation token
+(:mod:`utils.cancel`) — silently vanishes inside a bare
+``threading.Thread`` or ``ThreadPoolExecutor`` worker. Before the serve
+daemon that never mattered (one process = one job = one ambient); with
+multiple jobs resident in one process it is the difference between a
+worker honoring ITS job's byte budget and it reading some other job's.
+
+These wrappers capture the caller's context at submit/spawn time and run
+the target inside a private copy (a ``Context`` object may only be
+entered by one thread at a time, so every task gets its own copy — the
+copy is cheap, contexts are copy-on-write).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class CtxThreadPool(ThreadPoolExecutor):
+    """``ThreadPoolExecutor`` whose tasks run under a copy of the
+    SUBMITTER's contextvars context instead of the worker thread's empty
+    one. Drop-in for the driver pools (build/prefetch, write drains,
+    refinement) so job-scoped ambients survive the hop."""
+
+    def submit(self, fn, /, *args, **kwargs):
+        ctx = contextvars.copy_context()
+        return super().submit(ctx.run, fn, *args, **kwargs)
+
+    def map(self, fn, *iterables, timeout=None, chunksize=1):
+        # the parent's map would capture the WORKER's (empty) context;
+        # routing through submit() snapshots the caller's context per task
+        futures = [self.submit(fn, *args) for args in zip(*iterables)]
+
+        def gen():
+            for f in futures:
+                yield f.result(timeout)
+
+        return gen()
+
+
+def ctx_thread(target, args=(), *, name: str | None = None,
+               daemon: bool = True) -> threading.Thread:
+    """A ``threading.Thread`` whose target runs under a copy of the
+    CREATOR's contextvars context (captured now, not at start())."""
+    ctx = contextvars.copy_context()
+    return threading.Thread(target=ctx.run, args=(target, *args),
+                            name=name, daemon=daemon)

@@ -30,7 +30,9 @@ def test_installer_covers_every_cli_tool(installed_bin):
     # generic names install bst- prefixed (a bare `env`/`lint`/`config`
     # on PATH would shadow /usr/bin/env or unrelated same-named tools)
     renamed = {"env": "bst-env", "lint": "bst-lint", "config": "bst-config",
-               "trace-report": "bst-trace-report"}
+               "trace-report": "bst-trace-report",
+               "serve": "bst-serve", "submit": "bst-submit",
+               "jobs": "bst-jobs", "cancel": "bst-cancel"}
     expected = {renamed.get(t, t) for t in set(cli.commands)}
     missing = expected - wrappers
     assert not missing, f"installer missing wrappers for: {sorted(missing)}"
@@ -46,3 +48,11 @@ def test_trace_report_wrapper(installed_bin):
     w = installed_bin / "bst-trace-report"
     assert os.access(w, os.X_OK)
     assert re.search(r"cli\.main trace-report", w.read_text())
+
+
+def test_serve_wrappers(installed_bin):
+    for name, tool in (("bst-serve", "serve"), ("bst-submit", "submit"),
+                       ("bst-jobs", "jobs"), ("bst-cancel", "cancel")):
+        w = installed_bin / name
+        assert os.access(w, os.X_OK), name
+        assert re.search(rf"cli\.main {tool}", w.read_text()), name

@@ -262,6 +262,53 @@ class TestConfigRegistryCheck:
                 if f.check == "config-registry"] == []
 
 
+class TestEnvMutationCheck:
+    def test_raw_env_mutation_in_serve_flagged(self, tmp_path):
+        # the serve contract: a daemon job configuring itself by mutating
+        # the process env would leak into every concurrent job — the check
+        # points straight at config.overrides()
+        _write_tree(tmp_path, {"serve/daemon.py": """
+            import os
+
+
+            def run_job(overrides):
+                os.environ["BST_INFLIGHT_BYTES"] = "1000"       # line 5
+                os.environ.setdefault("BST_PAIR_SHARD", "0")    # line 6
+                os.environ.pop("BST_WRITE_THREADS", None)       # line 7
+                del os.environ["BST_TILE_CACHE_BYTES"]          # line 8
+                os.environ.update({"BST_TRACE": "1"})           # line 9
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "env-mutation"]
+        assert sorted(f.line for f in fs) == [5, 6, 7, 8, 9]
+        assert all("config.overrides" in f.message for f in fs)
+
+    def test_config_py_not_exempt(self, tmp_path):
+        # unlike config-registry, even the registry module may not WRITE
+        _write_tree(tmp_path, {"config.py": """
+            import os
+
+
+            def bad(name, value):
+                os.environ[name] = value     # dynamic name: not BST_-provable
+                os.environ["BST_X"] = value  # line 6
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "env-mutation"]
+        assert [f.line for f in fs] == [6]
+
+    def test_reads_and_non_bst_writes_are_clean(self, tmp_path):
+        _write_tree(tmp_path, {"config.py": """
+            import os
+
+
+            def fine():
+                a = os.environ.get("BST_FOO")
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                return a
+            """})
+        assert [f for f in run_lint(tmp_path)
+                if f.check == "env-mutation"] == []
+
+
 class TestMetricNameCheck:
     FILES = {
         "observe/metric_names.py": """

@@ -1,0 +1,530 @@
+"""The `bst serve` daemon: job queue scheduling, per-job config/telemetry
+isolation, E2E parity with the one-shot CLI path, warm-cache amortization,
+concurrency under shared byte windows, and mid-run cancellation.
+
+Daemons run IN-PROCESS on a tmp-path Unix socket (no subprocesses, so the
+jit caches the suite already warmed stay warm and the tests stay fast);
+the detach/foreground plumbing is exercised by scripts/serve_smoke.sh and
+the WORKFLOW doc test."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from bigstitcher_spark_tpu import config
+from bigstitcher_spark_tpu.cli.main import cli
+from bigstitcher_spark_tpu.observe import events, metrics
+from bigstitcher_spark_tpu.serve import client
+from bigstitcher_spark_tpu.serve.daemon import Daemon
+from bigstitcher_spark_tpu.serve.jobs import Job, JobQueue
+
+
+@pytest.fixture()
+def daemon(tmp_path):
+    """In-process daemon on a tmp socket; always shut down (and stdout
+    restored) even when the test body fails."""
+    d = Daemon(str(tmp_path / "bst.sock"), slots=2,
+               jobs_root=str(tmp_path / "jobs")).start()
+    try:
+        yield d
+    finally:
+        if not d.wait(timeout=0):
+            d.shutdown(drain=False, wait=True)
+
+
+def _mk_project(tmp_path, name="proj", **kw):
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    spec = dict(n_tiles=(2, 2, 1), tile_size=(96, 96, 32), overlap=24,
+                jitter=2.0, n_beads_per_tile=40, seed=7)
+    spec.update(kw)
+    return make_synthetic_project(str(tmp_path / name), **spec).xml_path
+
+
+def _read_vol(path, dataset="0"):
+    from bigstitcher_spark_tpu.io.chunkstore import ChunkStore
+
+    ds = ChunkStore.open(path).open_dataset(dataset)
+    size = tuple(ds.shape[:3]) + (1,) * (len(ds.shape) - 3)
+    return np.asarray(ds.read((0,) * len(ds.shape), size)).squeeze()
+
+
+def _cli_ok(runner, args):
+    r = runner.invoke(cli, args, catch_exceptions=False)
+    assert r.exit_code == 0, f"bst {' '.join(args)}\n{r.output}"
+    return r
+
+
+# -- queue scheduling (pure, no daemon) -------------------------------------
+
+
+class TestJobQueue:
+    def _job(self, jid, **kw):
+        return Job(id=jid, tool="config", args=[], **kw)
+
+    def test_priority_strictly_first(self):
+        q = JobQueue(slots=1)
+        q.submit(self._job("a", priority=0))
+        q.submit(self._job("b", priority=5))
+        q.submit(self._job("c", priority=1))
+        order = [q.take(0, timeout=1).id for _ in range(3)]
+        assert order == ["b", "c", "a"]
+
+    def test_fair_share_within_priority(self):
+        q = JobQueue(slots=1)
+        # alice has already consumed runtime; bob has not
+        ja = self._job("a1", share="alice")
+        q.submit(ja)
+        taken = q.take(0, timeout=1)
+        q.finish(taken, "done", exit_code=0)
+        assert q.share_runtime()["alice"] >= 0.0
+        q.submit(self._job("a2", share="alice"))
+        q.submit(self._job("b1", share="bob"))
+        # bob's accumulated runtime (0) < alice's -> bob first despite FIFO
+        assert q.take(0, timeout=1).id == "b1"
+
+    def test_lpt_plan_spreads_cost_over_slots(self):
+        q = JobQueue(slots=2)
+        for jid, cost in (("big", 10.0), ("m1", 4.0), ("m2", 3.0),
+                          ("s1", 2.0)):
+            q.submit(self._job(jid, cost=cost))
+        plan = q.plan()
+        assert sorted(len(b) for b in plan) == [1, 3]
+        # LPT: the heaviest job sits alone, the rest pack the other slot
+        loads = [sum({"big": 10, "m1": 4, "m2": 3, "s1": 2}[j] for j in b)
+                 for b in plan]
+        assert max(loads) - min(loads) <= 10.0
+
+    def test_cancel_queued_is_terminal(self):
+        q = JobQueue(slots=1)
+        q.submit(self._job("a"))
+        job = q.cancel("a")
+        assert job.state == "cancelled" and q.depth() == 0
+        assert q.take(0, timeout=0.1) is None
+
+    def test_close_rejects_and_cancels_queued(self):
+        q = JobQueue(slots=1)
+        q.submit(self._job("a"))
+        doomed = q.close()
+        assert [j.id for j in doomed] == ["a"]
+        with pytest.raises(RuntimeError):
+            q.submit(self._job("b"))
+
+    def test_finished_history_is_bounded(self):
+        from bigstitcher_spark_tpu.serve.jobs import MAX_FINISHED_JOBS
+
+        q = JobQueue(slots=1)
+        for i in range(MAX_FINISHED_JOBS + 50):
+            q.submit(self._job(f"j{i}"))
+            q.finish(q.take(0, timeout=1), "done", exit_code=0)
+        ids = {j.id for j in q.jobs()}
+        assert len(ids) == MAX_FINISHED_JOBS
+        assert "j0" not in ids                      # oldest aged out
+        assert f"j{MAX_FINISHED_JOBS + 49}" in ids  # newest kept
+
+
+# -- per-job config isolation (the override layer itself) -------------------
+
+
+class TestConfigOverrides:
+    def test_undeclared_override_rejected(self):
+        with pytest.raises(KeyError):
+            config.validate_overrides({"BST_NOT_A_KNOB": "1"})
+
+    def test_override_masks_env_and_restores(self, monkeypatch):
+        monkeypatch.setenv("BST_WRITE_THREADS", "5")
+        assert config.get_int("BST_WRITE_THREADS") == 5
+        with config.overrides({"BST_WRITE_THREADS": 2}):
+            assert config.get_int("BST_WRITE_THREADS") == 2
+            assert config.source("BST_WRITE_THREADS") == "override"
+            with config.overrides({"BST_WRITE_THREADS": None}):
+                # None masks back to the declared default, not the env
+                assert config.get_int("BST_WRITE_THREADS") == 8
+                assert config.source("BST_WRITE_THREADS") == "default"
+        assert config.get_int("BST_WRITE_THREADS") == 5
+        assert os.environ["BST_WRITE_THREADS"] == "5"
+
+    def test_interleaved_threads_see_only_their_own(self):
+        """Two 'jobs' with conflicting overrides, running interleaved on
+        two threads, each observe only their own values at every step."""
+        barrier = threading.Barrier(2, timeout=10)
+        seen: dict[str, list[int]] = {"a": [], "b": []}
+        errors: list = []
+
+        def job(label, value):
+            try:
+                with config.overrides({"BST_WRITE_THREADS": value}):
+                    for _ in range(4):
+                        barrier.wait()       # force interleaving
+                        seen[label].append(
+                            config.get_int("BST_WRITE_THREADS"))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        ta = threading.Thread(target=job, args=("a", 3))
+        tb = threading.Thread(target=job, args=("b", 7))
+        ta.start(); tb.start(); ta.join(); tb.join()
+        assert not errors
+        assert seen["a"] == [3, 3, 3, 3]
+        assert seen["b"] == [7, 7, 7, 7]
+        assert "BST_WRITE_THREADS" not in os.environ
+
+    def test_worker_threads_inherit_overrides(self):
+        from bigstitcher_spark_tpu.utils.threads import CtxThreadPool
+
+        with config.overrides({"BST_WRITE_THREADS": 11}):
+            with CtxThreadPool(max_workers=2) as pool:
+                vals = list(pool.map(
+                    lambda _: config.get_int("BST_WRITE_THREADS"),
+                    range(4)))
+        assert vals == [11, 11, 11, 11]
+
+
+# -- per-job event logs -----------------------------------------------------
+
+
+class TestPerJobEventLogs:
+    def test_two_jobs_write_separate_files(self, tmp_path):
+        events.open_job("jx", str(tmp_path / "jx"))
+        events.open_job("jy", str(tmp_path / "jy"))
+        barrier = threading.Barrier(2, timeout=10)
+
+        def run(label):
+            tok = events.activate_job(label)
+            try:
+                for i in range(3):
+                    barrier.wait()
+                    events.emit("log", message=f"{label}-{i}")
+            finally:
+                events.deactivate_job(tok)
+
+        ta = threading.Thread(target=run, args=("jx",))
+        tb = threading.Thread(target=run, args=("jy",))
+        ta.start(); tb.start(); ta.join(); tb.join()
+        px = events.close_job("jx")
+        py = events.close_job("jy")
+        assert os.path.basename(px).startswith("events-job-jx-")
+        assert os.path.basename(py).startswith("events-job-jy-")
+        msgs_x = [r["message"] for r in events.iter_events(px)]
+        msgs_y = [r["message"] for r in events.iter_events(py)]
+        assert msgs_x == ["jx-0", "jx-1", "jx-2"]
+        assert msgs_y == ["jy-0", "jy-1", "jy-2"]
+
+    def test_outside_job_scope_falls_back_to_default(self, tmp_path):
+        events.configure(str(tmp_path / "default"))
+        events.open_job("jz", str(tmp_path / "jz"))
+        events.emit("log", message="default-scope")
+        tok = events.activate_job("jz")
+        events.emit("log", message="job-scope")
+        events.deactivate_job(tok)
+        pz = events.close_job("jz")
+        pd = events.close()
+        assert [r["message"] for r in events.iter_events(pz)] == ["job-scope"]
+        assert [r["message"] for r in events.iter_events(pd)] == \
+            ["default-scope"]
+
+
+# -- daemon E2E -------------------------------------------------------------
+
+
+class TestDaemonE2E:
+    def test_three_sequential_jobs_match_one_shot_cli(self, tmp_path,
+                                                      daemon):
+        """Acceptance E2E: fusion + downsample + detection served by one
+        resident daemon are bit-identical to the one-shot CLI path, and
+        the second same-shape fusion job hits the warm compiled-fn
+        bucket (no recompile)."""
+        sock = daemon.socket_path
+        xml = _mk_project(tmp_path, "proj")
+        proj = os.path.dirname(xml)
+        runner = CliRunner()
+
+        def served(tool, args):
+            res = client.submit(sock, tool, args)
+            assert res["state"] == "done" and res["exit_code"] == 0, res
+            return res
+
+        cargs = ["-s", "ZARR", "-d", "UINT16", "--minIntensity", "0",
+                 "--maxIntensity", "65535"]
+        served("create-fusion-container",
+               ["-x", xml, "-o", f"{proj}/fused.ome.zarr", *cargs])
+        r1 = served("affine-fusion", ["-o", f"{proj}/fused.ome.zarr"])
+        served("downsample", ["-i", f"{proj}/dataset.n5",
+                              "-di", "setup0/timepoint0/s0",
+                              "-ds", "2,2,1"])
+        served("detect-interestpoints",
+               ["-x", xml, "-l", "beads", "-s", "1.8", "-t", "0.008",
+                "-dsxy", "1", "-dsz", "1"])
+        # second same-shape fusion: the resident process must reuse the
+        # compiled-fn bucket (the amortized-compile win of `bst serve`)
+        r2 = served("affine-fusion", ["-o", f"{proj}/fused.ome.zarr"])
+        assert r2["warm_compile_hits"] > 0
+        assert r1["warm_compile_hits"] == 0
+
+        # one-shot CLI path on an identical project (same seed)
+        xml_d = _mk_project(tmp_path, "direct")
+        proj_d = os.path.dirname(xml_d)
+        _cli_ok(runner, ["create-fusion-container", "-x", xml_d,
+                         "-o", f"{proj_d}/fused.ome.zarr", *cargs])
+        _cli_ok(runner, ["affine-fusion", "-o", f"{proj_d}/fused.ome.zarr"])
+        _cli_ok(runner, ["downsample", "-i", f"{proj_d}/dataset.n5",
+                         "-di", "setup0/timepoint0/s0", "-ds", "2,2,1"])
+        _cli_ok(runner, ["detect-interestpoints", "-x", xml_d,
+                         "-l", "beads", "-s", "1.8", "-t", "0.008",
+                         "-dsxy", "1", "-dsz", "1"])
+
+        assert np.array_equal(_read_vol(f"{proj}/fused.ome.zarr"),
+                              _read_vol(f"{proj_d}/fused.ome.zarr"))
+        assert np.array_equal(
+            _read_vol(f"{proj}/dataset.n5", "setup0/timepoint0/s1"),
+            _read_vol(f"{proj_d}/dataset.n5", "setup0/timepoint0/s1"))
+        from bigstitcher_spark_tpu.io.interestpoints import \
+            InterestPointStore
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+
+        sd, sd_d = SpimData.load(xml), SpimData.load(xml_d)
+        ips = InterestPointStore.for_project(sd)
+        ips_d = InterestPointStore.for_project(sd_d)
+        for v in sd.view_ids():
+            pts, _ = ips.load_points(v, "beads")
+            pts_d, _ = ips_d.load_points(v, "beads")
+            assert len(pts) and np.array_equal(pts, pts_d)
+
+        # per-job observability: each job left its own manifest + log
+        for res in (r1, r2):
+            d = res["telemetry_dir"]
+            files = os.listdir(d)
+            assert any(f.startswith("manifest-") for f in files), files
+            assert any(f.startswith("events-job-") for f in files), files
+            man = json.load(open(os.path.join(
+                d, next(f for f in files if f.startswith("manifest-")))))
+            assert man["tool"] == "affine-fusion"
+            assert man["status"] == "ok"
+            assert any(s.get("stage") == "affine-fusion"
+                       for s in man["stages"])
+
+    def test_output_log_and_override_isolation_through_daemon(
+            self, tmp_path, daemon):
+        """Two `bst config` jobs with conflicting overrides, back-to-back
+        and interleaved: each job's captured output shows only its own
+        values, and the daemon's environment never changes."""
+        sock = daemon.socket_path
+
+        def seen_value(res):
+            out = open(os.path.join(res["telemetry_dir"],
+                                    "output.log")).read()
+            rows = {r["name"]: r for r in json.loads(out)}
+            return (rows["BST_WRITE_THREADS"]["value"],
+                    rows["BST_WRITE_THREADS"]["source"])
+
+        r3 = client.submit(sock, "config", ["--json"],
+                           overrides={"BST_WRITE_THREADS": "3"})
+        r7 = client.submit(sock, "config", ["--json"],
+                           overrides={"BST_WRITE_THREADS": "7"})
+        assert seen_value(r3) == (3, "override")
+        assert seen_value(r7) == (7, "override")
+        # interleaved: both in flight on the two slots at once
+        results = {}
+
+        def go(key, val):
+            results[key] = client.submit(
+                sock, "config", ["--json"],
+                overrides={"BST_WRITE_THREADS": val})
+
+        ta = threading.Thread(target=go, args=("a", "3"))
+        tb = threading.Thread(target=go, args=("b", "7"))
+        ta.start(); tb.start(); ta.join(); tb.join()
+        assert seen_value(results["a"]) == (3, "override")
+        assert seen_value(results["b"]) == (7, "override")
+        assert "BST_WRITE_THREADS" not in os.environ
+
+    def test_bad_submissions_rejected(self, daemon):
+        sock = daemon.socket_path
+        with pytest.raises(RuntimeError, match="unknown or unservable"):
+            client.submit(sock, "no-such-tool", [])
+        with pytest.raises(RuntimeError, match="unknown or unservable"):
+            client.submit(sock, "submit", ["config"])   # no recursion
+        with pytest.raises(RuntimeError, match="undeclared knob"):
+            client.submit(sock, "config", [],
+                          overrides={"BST_TYPO": "1"})
+        with pytest.raises(RuntimeError, match="daemon-owned"):
+            client.submit(sock, "config", ["--telemetry-dir", "/tmp/x"])
+        with pytest.raises(RuntimeError, match="daemon-owned"):
+            # the fused --flag=value spelling must not slip past the guard
+            client.submit(sock, "config", ["--telemetry-dir=/tmp/x"])
+
+    def test_failed_job_isolated_daemon_survives(self, tmp_path, daemon):
+        sock = daemon.socket_path
+        bad = client.submit(sock, "affine-fusion",
+                            ["-o", str(tmp_path / "nope.zarr")])
+        assert bad["state"] == "failed" and bad["exit_code"] != 0
+        ok = client.submit(sock, "config", [])
+        assert ok["state"] == "done" and ok["exit_code"] == 0
+        listing = client.list_jobs(sock)
+        states = {j["id"]: j["state"] for j in listing["jobs"]}
+        assert set(states.values()) == {"failed", "done"}
+
+    def test_jobs_and_cancel_cli_commands(self, tmp_path, daemon):
+        runner = CliRunner()
+        sock = daemon.socket_path
+        client.submit(sock, "config", [])
+        r = _cli_ok(runner, ["jobs", "--socket", sock, "--json"])
+        payload = json.loads(r.output)
+        assert payload["daemon"]["slots"] == 2
+        assert payload["jobs"][0]["tool"] == "config"
+        assert "chunk_cache" in payload["daemon"]
+        r = _cli_ok(runner, ["jobs", "--socket", sock])
+        assert "compiled-fn warm" in r.output
+        r = runner.invoke(cli, ["cancel", "--socket", sock, "zzz"])
+        assert r.exit_code != 0     # unknown job id -> ClickException
+
+
+class TestDaemonConcurrency:
+    def test_concurrent_jobs_complete_within_byte_budget(self, tmp_path,
+                                                         daemon):
+        """Acceptance: two jobs submitted together both complete; the
+        shared in-flight high-water gauge never exceeds the single-job
+        budget because the daemon splits the derived windows per slot."""
+        from bigstitcher_spark_tpu.utils.devicemem import \
+            dispatch_budget_bytes
+
+        sock = daemon.socket_path
+        xml = _mk_project(tmp_path, "proj")
+        proj = os.path.dirname(xml)
+        cargs = ["-s", "ZARR", "-d", "UINT16", "--minIntensity", "0",
+                 "--maxIntensity", "65535"]
+        for out in ("outA", "outB"):
+            res = client.submit(sock, "create-fusion-container",
+                                ["-x", xml, "-o", f"{proj}/{out}.zarr",
+                                 "--blockSize", "32,32,32", *cargs])
+            assert res["exit_code"] == 0
+        base = dispatch_budget_bytes()
+        hw = metrics.gauge("bst_inflight_bytes_highwater")
+        hw.set(0)   # fresh high-water for this window-sharing assertion
+        results = {}
+
+        # small compute blocks => every batch fits well inside its job's
+        # split window, so the windows GATE (the ledger's must-dispatch
+        # head-batch rule can only exceed a budget when one batch alone
+        # is bigger than the whole budget)
+        def go(out):
+            results[out] = client.submit(
+                sock, "affine-fusion",
+                ["-o", f"{proj}/{out}.zarr", "--blockScale", "1,1,1"])
+
+        ta = threading.Thread(target=go, args=("outA",))
+        tb = threading.Thread(target=go, args=("outB",))
+        ta.start(); tb.start(); ta.join(); tb.join()
+        assert results["outA"]["state"] == "done"
+        assert results["outB"]["state"] == "done"
+        assert np.array_equal(_read_vol(f"{proj}/outA.zarr"),
+                              _read_vol(f"{proj}/outB.zarr"))
+        assert hw.value <= base, (hw.value, base)
+
+    def test_cancel_mid_run_leaves_other_job_intact(self, tmp_path,
+                                                    daemon):
+        """Acceptance: of two concurrent fusions, cancelling one mid-run
+        (at its first stage heartbeat) leaves the other's output
+        bit-identical to the direct CLI run."""
+        sock = daemon.socket_path
+        # the doomed job gets a LARGE grid of tiny blocks (many batches =
+        # many cancel safe-points); the surviving job runs the normal shape
+        xml = _mk_project(tmp_path, "proj", tile_size=(128, 128, 32))
+        proj = os.path.dirname(xml)
+        cargs = ["-s", "ZARR", "-d", "UINT16", "--minIntensity", "0",
+                 "--maxIntensity", "65535"]
+        for out, bs in (("keep", "64,64,32"), ("doom", "16,16,16")):
+            res = client.submit(sock, "create-fusion-container",
+                                ["-x", xml, "-o", f"{proj}/{out}.zarr",
+                                 "--blockSize", bs, *cargs])
+            assert res["exit_code"] == 0
+
+        cancelled_at = []
+
+        def on_event(rec):
+            # first sign of the doomed fusion actually running -> cancel
+            if (rec.get("type") in ("stage.start", "stage.progress")
+                    and not cancelled_at):
+                cancelled_at.append(rec)
+                client.cancel(sock, rec["job"])
+
+        results = {}
+
+        def go_doom():
+            results["doom"] = client.submit(
+                sock, "affine-fusion",
+                ["-o", f"{proj}/doom.zarr", "--blockScale", "1,1,1"],
+                on_event=on_event)
+
+        def go_keep():
+            results["keep"] = client.submit(
+                sock, "affine-fusion", ["-o", f"{proj}/keep.zarr"])
+
+        td = threading.Thread(target=go_doom)
+        tk = threading.Thread(target=go_keep)
+        td.start(); tk.start(); td.join(); tk.join()
+        assert results["doom"]["state"] == "cancelled", results["doom"]
+        assert results["keep"]["state"] == "done", results["keep"]
+        assert cancelled_at, "cancel never fired mid-run"
+
+        runner = CliRunner()
+        xml_d = _mk_project(tmp_path, "direct", tile_size=(128, 128, 32))
+        proj_d = os.path.dirname(xml_d)
+        _cli_ok(runner, ["create-fusion-container", "-x", xml_d,
+                         "-o", f"{proj_d}/keep.zarr",
+                         "--blockSize", "64,64,32", *cargs])
+        _cli_ok(runner, ["affine-fusion", "-o", f"{proj_d}/keep.zarr"])
+        assert np.array_equal(_read_vol(f"{proj}/keep.zarr"),
+                              _read_vol(f"{proj_d}/keep.zarr"))
+
+    def test_shutdown_drain_cancels_queued_finishes_running(self, tmp_path,
+                                                            daemon):
+        sock = daemon.socket_path
+        # saturate both slots, then queue one more and drain
+        accepted = [client.submit(sock, "config", [], follow=False)
+                    for _ in range(3)]
+        client.shutdown(sock, drain=True)
+        assert daemon.wait(timeout=60)
+        states = {j.id: j.state for j in daemon.queue.jobs()}
+        assert len(accepted) == 3
+        assert set(states.values()) <= {"done", "cancelled"}
+        # socket is gone: clients see a clear connection error
+        with pytest.raises(OSError):
+            client.ping(sock, timeout=1.0)
+
+
+class TestWarmth:
+    def test_compile_bucket_counters_move(self):
+        from bigstitcher_spark_tpu.parallel.mesh import record_compile_bucket
+
+        warm = metrics.counter("bst_compiled_fn_warm_hits_total")
+        cold = metrics.counter("bst_compiled_fn_cold_builds_total")
+        w0, c0 = warm.value, cold.value
+        key = ("test-bucket", time.time())
+        assert record_compile_bucket(key) is False
+        assert record_compile_bucket(key) is True
+        assert cold.value == c0 + 1 and warm.value == w0 + 1
+
+    def test_bucket_mirror_tracks_lru_eviction(self):
+        """The warm counter must not claim warmth for signatures the
+        bounded factory lru_cache has already evicted (and will
+        recompile)."""
+        from bigstitcher_spark_tpu.parallel.mesh import record_compile_bucket
+
+        stamp = time.time()
+        first = ("sharded", "evict-test", stamp, 0)
+        assert record_compile_bucket(first) is False
+        for i in range(1, 70):   # > the sharded cache's 64-entry capacity
+            record_compile_bucket(("sharded", "evict-test", stamp, i))
+        assert record_compile_bucket(first) is False   # evicted: cold again
+
+    def test_chunk_cache_stats_surface(self):
+        from bigstitcher_spark_tpu.io.chunkcache import get_cache
+
+        st = get_cache().stats()
+        assert {"entries", "bytes", "hits", "misses"} <= set(st)
