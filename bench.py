@@ -477,7 +477,7 @@ def _io_snapshot(baseline):
             if k.startswith(("bst_io_", "bst_xfer_", "bst_chunk_cache_",
                              "bst_tile_cache_", "bst_inflight_",
                              "bst_pair_", "bst_trace_", "bst_epilogue_",
-                             "bst_serve_", "bst_compiled_fn_"))
+                             "bst_serve_", "bst_compiled_fn_", "bst_dag_"))
             and isinstance(v, (int, float)) and v}
 
 
@@ -1020,6 +1020,140 @@ def measure_fusion_pyramid(xml_path):
             "same-run numpy fusion rate + same-run numpy container-reread "
             "downsample chain on this host"),
         "spans": spans,
+        "io": io,
+    }
+
+
+def measure_pipeline(xml_path):
+    """Staged vs streamed stage-DAG execution of the same workload
+    (resave -> create -> affine-fusion -> downsample -> detect):
+
+    - **staged** runs the five one-shot CLI commands in sequence with
+      real containers between stages, clearing the decoded-chunk cache
+      between commands so the leg prices what users actually run — one
+      process per stage, cold caches each (the in-process invocation
+      would otherwise smuggle the chunk cache across stages and
+      understate the container round-trip);
+    - **streamed** runs the identical commands through `bst pipeline`
+      (dag/executor.py): consumers start on block completion, blocks
+      hand over through the decoded-chunk cache, and the resaved
+      intermediate is elided to a memory:// root.
+
+    Reported: both wall clocks, the staged leg's consumer-stage
+    container-read bytes (the round trip the executor attacks), and the
+    streamed leg's elided-vs-reread byte split from the `bst_dag_*`
+    counters (ROADMAP item 2's >=90%-elision acceptance bar)."""
+    from bigstitcher_spark_tpu.dag import run_pipeline
+    from bigstitcher_spark_tpu.dag.executor import _invoke_tool
+    from bigstitcher_spark_tpu.io.chunkcache import get_cache
+    from bigstitcher_spark_tpu.observe import metrics as _om
+
+    def run_tool(args):
+        rc = _invoke_tool(args[0], args[1:])
+        if rc:
+            raise RuntimeError(f"bst {' '.join(args)} exited {rc}")
+
+    def stage_cmds(root, xml):
+        rexml = os.path.join(root, "bench-pipeline-resaved.xml")
+        resaved = os.path.join(root, "bench-pipeline-resaved.n5")
+        fused = os.path.join(root, "bench-pipeline-fused.n5")
+        return rexml, resaved, fused, [
+            ["resave", "-x", xml, "-xo", rexml, "-o", resaved, "--N5"],
+            ["create-fusion-container", "-x", rexml, "-o", fused,
+             "-s", "N5", "-d", "UINT16", "--minIntensity", "0",
+             "--maxIntensity", "65535"],
+            ["affine-fusion", "-o", fused],
+            ["downsample", "-i", fused, "-di", "ch0tp0/s0",
+             "-ds", "2,2,1"],
+            ["detect-interestpoints", "-x", rexml, "-l", "beads",
+             "-s", "1.8", "-t", "0.008", "-dsxy", "1", "-dsz", "1"],
+        ]
+
+    def read_bytes_snapshot():
+        # real container decodes only: the path="cache" series is bytes
+        # served by the in-process chunk cache, which a process-per-stage
+        # run would ALSO serve from memory within one stage — counting it
+        # would inflate the round trip streaming is credited with killing
+        return sum(v for k, v in _om.get_registry().snapshot().items()
+                   if k.startswith("bst_io_read_bytes_total")
+                   and '"cache"' not in k)
+
+    # -- staged leg: one-shot CLIs, containers between stages --------------
+    staged_root = os.path.join(FIXTURE, "pipeline-staged")
+    shutil.rmtree(staged_root, ignore_errors=True)
+    os.makedirs(staged_root, exist_ok=True)
+    _, resaved, _, cmds = stage_cmds(staged_root, xml_path)
+    t0 = time.time()
+    consumer_reads = 0
+    for i, cmd in enumerate(cmds):
+        get_cache().clear()       # process-per-stage: no cross-stage cache
+        before = read_bytes_snapshot()
+        run_tool(cmd)
+        if i >= 2:                # fuse / downsample / detect re-read
+            consumer_reads += read_bytes_snapshot() - before
+    staged_s = time.time() - t0
+
+    # -- streamed leg: the DAG executor on an identical spec ---------------
+    streamed_root = os.path.join(FIXTURE, "pipeline-streamed")
+    shutil.rmtree(streamed_root, ignore_errors=True)
+    os.makedirs(streamed_root, exist_ok=True)
+    rexml, resaved, fused, _ = stage_cmds(streamed_root, xml_path)
+    spec = {
+        "name": "bench-streamed",
+        "datasets": {"resaved": {"path": resaved, "ephemeral": True},
+                     "fused": {"path": fused}},
+        "stages": [
+            {"id": "resave", "tool": "resave",
+             "args": ["-x", xml_path, "-xo", rexml, "-o", "@resaved",
+                      "--N5"],
+             "writes": ["resaved"]},
+            {"id": "create", "tool": "create-fusion-container",
+             "args": ["-x", rexml, "-o", "@fused", "-s", "N5",
+                      "-d", "UINT16", "--minIntensity", "0",
+                      "--maxIntensity", "65535"],
+             "after": ["resave"]},
+            {"id": "fuse", "tool": "affine-fusion",
+             "args": ["-o", "@fused"],
+             "after": ["create"], "reads": ["resaved"],
+             "writes": ["fused"]},
+            {"id": "downsample", "tool": "downsample",
+             "args": ["-i", "@fused", "-di", "ch0tp0/s0", "-ds", "2,2,1"],
+             "reads": ["fused"], "writes": ["fused"]},
+            {"id": "detect", "tool": "detect-interestpoints",
+             "args": ["-x", rexml, "-l", "beads", "-s", "1.8",
+                      "-t", "0.008", "-dsxy", "1", "-dsz", "1"],
+             "after": ["resave"], "reads": ["resaved"]},
+        ],
+    }
+    get_cache().clear()
+    iob = _io_baseline()
+    t0 = time.time()
+    res = run_pipeline(spec, workdir=streamed_root)
+    streamed_s = time.time() - t0
+    io = _io_snapshot(iob)
+    summary = res.to_dict()
+    assert summary["ok"], summary
+    elided = summary["bytes_elided"]
+    reread = summary["bytes_reread"]
+    elision_pct = round(100.0 * elided / max(elided + reread, 1), 2)
+    return {
+        "metric": "pipeline_staged_over_streamed",
+        "value": round(staged_s / max(streamed_s, 1e-9), 3),
+        "unit": "x",
+        "note": ("same resave->create->fuse->downsample->detect workload "
+                 "as five one-shot CLIs with containers between stages "
+                 "(cache cleared per stage = process-per-stage flow) vs "
+                 "one streamed `bst pipeline` run with the resaved "
+                 "intermediate elided to memory"),
+        "staged_seconds": round(staged_s, 3),
+        "streamed_seconds": round(streamed_s, 3),
+        "staged_consumer_read_bytes": int(consumer_reads),
+        "streamed_bytes_elided": int(elided),
+        "streamed_bytes_reread": int(reread),
+        "elision_pct": elision_pct,
+        "blocks_streamed": summary["blocks_streamed"],
+        "containers_elided": summary["containers_elided"],
+        "edges": summary["edges"],
         "io": io,
     }
 
@@ -1629,6 +1763,7 @@ def _finalize(result, truncated=None):
 EXTRA_MEASURES = (
     ("kernel", lambda xml: measure_kernel_only(xml)),
     ("fusion_pyramid", lambda xml: measure_fusion_pyramid(xml)),
+    ("pipeline", lambda xml: measure_pipeline(xml)),
     ("submit_latency", lambda xml: measure_submit_latency(xml)),
     ("phasecorr", lambda xml: measure_phasecorr(xml)),
     ("phasecorr_kernel", lambda xml: measure_phasecorr_kernel(xml)),

@@ -14,6 +14,7 @@ from . import (
     detection_tools,
     fusion_tools,
     intensity_tools,
+    pipeline_tools,
     resave_tools,
     serve_tools,
     solver_tools,
@@ -60,6 +61,7 @@ cli.add_command(serve_tools.serve_cmd, "serve")
 cli.add_command(serve_tools.submit_cmd, "submit")
 cli.add_command(serve_tools.jobs_cmd, "jobs")
 cli.add_command(serve_tools.cancel_cmd, "cancel")
+cli.add_command(pipeline_tools.pipeline_cmd, "pipeline")
 
 
 def main():

@@ -1,0 +1,128 @@
+"""``bst pipeline`` — run whole stage DAGs through the streaming executor.
+
+``bst pipeline run <spec.json>`` executes every stage in ONE process:
+consumers start the moment their input blocks exist, blocks hand over in
+memory, and ephemeral intermediates never touch disk. ``bst pipeline
+init`` writes a runnable example spec for a project XML; ``bst submit
+--pipeline <spec.json>`` runs the same spec inside a resident `bst
+serve` daemon (warm mesh + caches across pipelines)."""
+
+from __future__ import annotations
+
+import json as _json
+import os
+
+import click
+
+from .common import infrastructure_options
+from .telemetry_tools import _fmt_bytes
+
+
+@click.group("pipeline")
+def pipeline_cmd():
+    """Streaming block-granular stage-DAG pipelines."""
+
+
+@pipeline_cmd.command("run")
+@infrastructure_options
+@click.argument("spec", type=click.Path(exists=True, dir_okay=False))
+@click.option("--workdir", default=None,
+              help="directory relative dataset paths and @workdir resolve "
+                   "against (default: the spec file's directory)")
+@click.option("--keep-intermediates", "keep", is_flag=True, default=False,
+              help="materialize ephemeral datasets at their declared "
+                   "paths and keep them after the run (default: elide "
+                   "them to in-process memory:// roots, cleaned up on "
+                   "success and on failure)")
+@click.option("--json", "as_json", is_flag=True, default=False,
+              help="print the machine-readable run summary (interleaved "
+                   "with the stages' own output — scripts should prefer "
+                   "--summary)")
+@click.option("--summary", "summary_path", default=None,
+              type=click.Path(dir_okay=False),
+              help="also write the machine-readable run summary JSON to "
+                   "this file (stage stdout cannot pollute it)")
+def run_cmd(spec, workdir, keep, as_json, summary_path, dry_run):
+    """Execute the pipeline SPEC (stage nodes + dataset edges, JSON)."""
+    from ..dag import PipelineSpec, SpecError, run_pipeline
+
+    try:
+        ps = PipelineSpec.load(spec)
+    except SpecError as e:
+        raise click.ClickException(str(e)) from e
+    if dry_run:
+        click.echo(f"pipeline {ps.name}: {len(ps.stages)} stage(s)")
+        for s in ps.stages:
+            deps = sorted(ps.barrier_parents(s))
+            sdeps = sorted(ps.stream_parents(s))
+            line = f"  {s.id}: {s.tool}"
+            if deps:
+                line += f"  after={','.join(deps)}"
+            if sdeps:
+                line += f"  streams-from={','.join(sdeps)}"
+            click.echo(line)
+        click.echo("(dry run, not executing)")
+        return
+    try:
+        res = run_pipeline(
+            ps, workdir=workdir or os.path.dirname(os.path.abspath(spec)),
+            keep_intermediates=keep)
+    except SpecError as e:
+        raise click.ClickException(str(e)) from e
+    if summary_path:
+        with open(summary_path, "w", encoding="utf-8") as fh:
+            _json.dump(res.to_dict(), fh, indent=1)
+            fh.write("\n")
+    if as_json:
+        click.echo(_json.dumps(res.to_dict(), indent=1))
+    else:
+        click.echo(f"pipeline {res.name}:")
+        for row in res.stages:
+            line = f"  {row['id']:<12} {row['state']:<10}"
+            if "seconds" in row:
+                line += f" {row['seconds']}s"
+            if row.get("error"):
+                line += f"  {row['error']}"
+            click.echo(line)
+        for e in res.edges:
+            tag = "elided container" if e["elided"] else (
+                "streamed" if e["stream"] else "barrier")
+            click.echo(
+                f"  edge {e['edge']}: {e['blocks_streamed']} blocks "
+                f"streamed, {_fmt_bytes(e['bytes_elided'])} handed over "
+                f"in memory, {_fmt_bytes(e['bytes_reread'])} re-read "
+                f"({tag})")
+        click.echo(f"  {res.seconds:.1f}s total; "
+                   f"{res.containers_elided} intermediate container(s) "
+                   f"elided")
+    if not res.ok:
+        bad = [r["id"] for r in res.stages if r["state"] != "done"]
+        raise click.ClickException(f"stage(s) failed/cancelled: "
+                                   f"{', '.join(bad)}")
+
+
+@pipeline_cmd.command("init")
+@click.argument("out", type=click.Path(dir_okay=False))
+@click.option("-x", "--xml", "xml", required=True,
+              type=click.Path(exists=True, dir_okay=False),
+              help="project XML the generated pipeline processes")
+@click.option("--prefix", default="pipeline",
+              help="name prefix for the pipeline's containers/XML "
+                   "(written next to the project XML)")
+@click.option("--force", is_flag=True, default=False,
+              help="overwrite an existing spec file")
+def init_cmd(out, xml, prefix, force):
+    """Write a runnable example spec (streamed resave -> fuse ->
+    downsample -> detect) for the project XML to OUT."""
+    from ..dag import PipelineSpec, example_spec
+
+    if os.path.exists(out) and not force:
+        raise click.ClickException(f"{out} exists (use --force)")
+    d = example_spec(xml, prefix=prefix)
+    PipelineSpec.from_dict(d)   # never emit a spec that does not validate
+    with open(out, "w", encoding="utf-8") as f:
+        _json.dump(d, f, indent=1)
+        f.write("\n")
+    click.echo(f"wrote {out} ({len(d['stages'])} stages); run it with "
+               f"`bst pipeline run {out}` or submit it to a daemon with "
+               f"`bst submit --pipeline {out}`")

@@ -90,19 +90,41 @@ def _parse_sets(pairs) -> dict:
                    "other jobs do not)")
 @click.option("--cost", type=float, default=1.0,
               help="relative cost for LPT slot placement")
+@click.option("--after", "after", multiple=True,
+              metavar="JOB-ID[,JOB-ID...]",
+              help="dependency edge(s): stay queued until these jobs "
+                   "succeed; cancel if any of them fails or is cancelled "
+                   "(repeatable / comma-separated)")
+@click.option("--pipeline", "pipeline_spec", default=None,
+              type=click.Path(exists=True, dir_okay=False),
+              help="submit a whole pipeline spec (see `bst pipeline`) as "
+                   "one daemon job — stages chain on the daemon's warm "
+                   "mesh and caches, streaming blocks between them "
+                   "in-process; TOOL/ARGS become extra `pipeline run` "
+                   "flags (e.g. --keep-intermediates)")
 @click.option("--follow/--no-follow", default=True,
               help="stream heartbeats and exit with the job's exit code "
                    "(default) vs. return the job id immediately")
 @click.option("--quiet", is_flag=True, default=False,
               help="suppress heartbeat lines (exit code only)")
-@click.argument("tool")
+@click.argument("tool", required=False)
 @click.argument("args", nargs=-1, type=click.UNPROCESSED)
-def submit_cmd(socket_path, priority, share, sets, cost, follow, quiet,
-               tool, args):
-    """Submit TOOL [ARGS...] to the serve daemon.
+def submit_cmd(socket_path, priority, share, sets, cost, after,
+               pipeline_spec, follow, quiet, tool, args):
+    """Submit TOOL [ARGS...] (or --pipeline SPEC) to the serve daemon.
 
     Example: bst submit affine-fusion -o fused.ome.zarr"""
+    import os
+
     from ..serve import client
+
+    after_ids = [a for spec in after for a in spec.split(",") if a]
+    if pipeline_spec is not None:
+        extra = ([tool] if tool else []) + list(args)
+        tool = "pipeline"
+        args = ["run", os.path.abspath(pipeline_spec), *extra]
+    elif tool is None:
+        raise click.UsageError("TOOL required (or --pipeline SPEC)")
 
     def on_event(rec):
         if quiet:
@@ -122,8 +144,8 @@ def submit_cmd(socket_path, priority, share, sets, cost, follow, quiet,
     try:
         resp = client.submit(
             socket_path, tool, list(args), priority=priority, share=share,
-            overrides=_parse_sets(sets), cost=cost, follow=follow,
-            on_event=on_event)
+            overrides=_parse_sets(sets), cost=cost, after=after_ids,
+            follow=follow, on_event=on_event)
     except (OSError, RuntimeError) as e:
         raise click.ClickException(
             f"{e} — is a daemon running? start one with `bst serve`")
@@ -178,6 +200,8 @@ def jobs_cmd(socket_path, as_json):
             line += f" run {j['seconds']}s"
         if j.get("exit_code") is not None:
             line += f" exit {j['exit_code']}"
+        if j.get("waiting_on"):
+            line += f" after {','.join(j['waiting_on'])}"
         click.echo(line)
 
 

@@ -175,6 +175,17 @@ _knob("BST_SERVE_IDLE_TIMEOUT", "int", 0,
       "daemon exits on its own (0 = run until shutdown). CI smoke runs "
       "set it so a crashed client can never leak a resident daemon.")
 
+# -- streaming stage-DAG executor (dag/) -----------------------------------
+_knob("BST_DAG_EXCHANGE_BYTES", "bytes", 256 << 20,
+      "Byte budget of the block-exchange ledger between a streaming "
+      "pipeline's producer and consumer stages (dag/stream.py): a "
+      "producer whose published-but-unconsumed blocks exceed this stalls "
+      "until consumers catch up (unless a consumer is starved waiting "
+      "for unpublished blocks — then the producer always proceeds). "
+      "0 disables backpressure. Full in-memory elision additionally "
+      "needs BST_CHUNK_CACHE_BYTES >= this budget, or evicted handoff "
+      "chunks fall back to a container decode.")
+
 # -- install wrappers ------------------------------------------------------
 _knob("BST_DEVICES", "int", None,
       "Virtual CPU mesh size (xla_force_host_platform_device_count) "

@@ -1,0 +1,22 @@
+"""Streaming block-granular stage-DAG executor (`bst pipeline`).
+
+Declares pipelines of existing ``bst`` tools as stage nodes with dataset
+edges, runs them in one process on the warm mesh and caches, tracks
+readiness at output-block granularity (a consumer starts while its
+producer is still writing), hands blocks over in memory through the
+decoded-chunk cache, and optionally elides intermediate containers to
+``memory://`` roots entirely — killing the write-then-reread round trip
+between resave, fusion, downsampling and detection.
+
+- :mod:`dag.spec` — the pipeline spec model (JSON + Python API).
+- :mod:`dag.stream` — the block-exchange registry hooked into
+  ``Dataset.read``/``write``.
+- :mod:`dag.executor` — stage scheduling, failure-cone cancellation,
+  ephemeral-container lifecycle.
+"""
+
+from .executor import PipelineResult, run_pipeline
+from .spec import PipelineSpec, SpecError, example_spec
+
+__all__ = ["PipelineResult", "PipelineSpec", "SpecError", "example_spec",
+           "run_pipeline"]

@@ -1,0 +1,362 @@
+"""The streaming stage-DAG executor: run a pipeline spec in one process.
+
+Where the one-shot flow runs resave, detection, fusion and downsampling
+as separate processes with full containers between them, this executor
+runs the SAME click commands as stage nodes of a DAG, in one process, on
+one warm mesh and one set of process-wide caches:
+
+- a stage STARTS when its barrier parents (explicit ``after`` edges and
+  producers of its non-streamed inputs) are done and its streamed
+  producers have merely *started* — readiness below stage granularity is
+  the stream registry's job (dag/stream.py), which gates each consumer
+  read on the producer's block completions;
+- a stage that fails or is cancelled poisons its downstream cone
+  (transitively, via each stage's cancel token); independent branches
+  run to completion;
+- ephemeral intermediates are elided to ``memory://`` roots (or a
+  run-scoped temp dir with disk backing) and cleaned up on success AND
+  on failure/cancel, through ``ChunkStore.remove`` so the decoded-chunk
+  cache sees the write-generation bump;
+- inside a ``bst serve`` job the ambient job cancel token is polled by
+  the coordination loop, so cancelling the daemon job poisons every
+  stage.
+
+The executor is single-process by design (the block exchange and the
+``memory://`` elision live in process memory); multi-host pipelines run
+each stage's existing multi-host path INSIDE one process per host, which
+this executor does not orchestrate.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from dataclasses import dataclass, field
+
+from .. import observe, profiling
+from ..observe import metrics as _metrics
+from ..utils import cancel as _cancel
+from ..utils.threads import ctx_thread
+from . import stream
+from .spec import PipelineSpec, SpecError, StageSpec
+
+PENDING = "pending"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+
+_TERMINAL = (DONE, FAILED, CANCELLED)
+
+_STAGES_DONE = {s: _metrics.counter("bst_dag_stages_completed_total",
+                                    status=s) for s in _TERMINAL}
+_CONTAINERS_ELIDED = _metrics.counter("bst_dag_containers_elided_total")
+
+
+@dataclass
+class StageRun:
+    """One stage's execution state."""
+
+    spec: StageSpec
+    token: stream.StageToken
+    cancel: _cancel.CancelToken = field(default_factory=_cancel.CancelToken)
+    state: str = PENDING
+    error: str | None = None
+    started_at: float | None = None
+    finished_at: float | None = None
+
+    def summary(self) -> dict:
+        d = {"id": self.spec.id, "tool": self.spec.tool,
+             "state": self.state}
+        if self.started_at is not None:
+            d["seconds"] = round((self.finished_at or time.time())
+                                 - self.started_at, 3)
+        if self.error:
+            d["error"] = self.error
+        return d
+
+
+@dataclass
+class PipelineResult:
+    name: str
+    ok: bool
+    seconds: float
+    stages: list[dict]
+    edges: list[dict]
+    containers_elided: int
+    kept_intermediates: list[str]
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "ok": self.ok,
+            "seconds": round(self.seconds, 3),
+            "stages": self.stages, "edges": self.edges,
+            "containers_elided": self.containers_elided,
+            "kept_intermediates": self.kept_intermediates,
+            "bytes_elided": sum(e["bytes_elided"] for e in self.edges),
+            "bytes_reread": sum(e["bytes_reread"] for e in self.edges),
+            "blocks_streamed": sum(e["blocks_streamed"]
+                                   for e in self.edges),
+        }
+
+
+def _new_run_id() -> str:
+    # pid + monotonic tick: unique within this host's concurrent runs
+    # without touching wall-clock randomness
+    return f"{os.getpid():x}-{time.monotonic_ns() & 0xFFFFFFFF:08x}"
+
+
+def _invoke_tool(tool: str, args: list[str]) -> int:
+    """Run one registered click command in-process (the same invocation
+    surface the serve daemon uses). Returns the exit code; raises on
+    hard errors so the stage records the message."""
+    import click
+
+    from ..cli.main import cli as _cli
+
+    try:
+        _cli(args=[tool, *args], prog_name="bst", standalone_mode=False)
+    except click.exceptions.Exit as e:
+        return int(e.exit_code or 0)
+    except SystemExit as e:
+        return int(e.code) if isinstance(e.code, int) else 1
+    return 0
+
+
+def _remove_container(root: str) -> None:
+    """Best-effort removal of an (ephemeral) container root — local trees
+    rmtree'd, memory:// roots deleted from the shared kvstore — through
+    ChunkStore.remove so the chunk cache invalidates and write
+    generations bump."""
+    from ..io.chunkstore import ChunkStore, StorageFormat
+
+    try:
+        ChunkStore(root, StorageFormat.N5).remove("")
+    except Exception as e:  # cleanup must never mask the run's outcome
+        observe.log(f"pipeline: cleanup of {root} failed: {e!r}",
+                    stage="pipeline")
+
+
+class _Executor:
+    def __init__(self, spec: PipelineSpec, run_id: str):
+        self.spec = spec
+        self.run_id = run_id
+        self._lock = threading.Lock()
+        self._changed = threading.Condition(self._lock)
+        self.runs = {
+            s.id: StageRun(spec=s, token=stream.StageToken(s.id, run_id))
+            for s in spec.stages
+        }
+
+    # -- dependency queries (pure) -----------------------------------------
+
+    def _children(self, sid: str) -> set[str]:
+        return {s.id for s in self.spec.stages
+                if sid in self.spec.parents(s)}
+
+    def _cone(self, sid: str) -> set[str]:
+        out, work = set(), [sid]
+        while work:
+            cur = work.pop()
+            for c in self._children(cur):
+                if c not in out:
+                    out.add(c)
+                    work.append(c)
+        return out
+
+    def _eligible_locked(self, run: StageRun) -> bool:
+        for p in self.spec.barrier_parents(run.spec):
+            if self.runs[p].state != DONE:
+                return False
+        for p in self.spec.stream_parents(run.spec):
+            if self.runs[p].state not in (RUNNING, DONE):
+                return False
+        return True
+
+    def _doomed_locked(self, run: StageRun) -> bool:
+        return any(self.runs[p].state in (FAILED, CANCELLED)
+                   for p in self.spec.parents(run.spec))
+
+    # -- stage thread -------------------------------------------------------
+
+    def _run_stage(self, run: StageRun) -> None:
+        import click
+
+        state, err = DONE, None
+        try:
+            with _cancel.scope(run.cancel), \
+                    stream.stage_scope(run.token), \
+                    profiling.span("dag.stage", stage=run.spec.id):
+                rc = _invoke_tool(run.spec.tool, run.spec.args)
+                if rc != 0:
+                    state, err = FAILED, f"exit code {rc}"
+        except _cancel.Cancelled:
+            state, err = CANCELLED, "cancelled"
+        except click.ClickException as e:
+            state, err = FAILED, e.format_message()
+        except BaseException as e:  # noqa: BLE001 — stage crash isolation
+            state, err = FAILED, repr(e)[:500]
+        stream.registry().stage_finished(run.token)
+        with self._changed:
+            run.state = state
+            run.error = err
+            run.finished_at = time.time()
+            _STAGES_DONE[state].inc()
+            if state != DONE:
+                self._poison_cone_locked(run.spec.id)
+            self._changed.notify_all()
+        observe.log(f"pipeline: stage {run.spec.id} {state}"
+                    f"{' (' + err + ')' if err else ''}", stage="pipeline")
+
+    def _poison_cone_locked(self, sid: str) -> None:
+        """A terminal non-DONE stage cancels its downstream cone: running
+        descendants get their token set (the work loops unwind at their
+        safe points), pending ones flip straight to CANCELLED."""
+        for did in self._cone(sid):
+            d = self.runs[did]
+            d.cancel.cancel()
+            if d.state == PENDING:
+                d.state = CANCELLED
+                d.error = f"upstream {sid} failed/cancelled"
+                d.finished_at = time.time()
+                _STAGES_DONE[CANCELLED].inc()
+                stream.registry().stage_finished(d.token)
+
+    # -- coordination loop --------------------------------------------------
+
+    def run(self) -> None:
+        threads: list[threading.Thread] = []
+        with self._changed:
+            while True:
+                for run in self.runs.values():
+                    if run.state != PENDING:
+                        continue
+                    if self._doomed_locked(run):
+                        run.state = CANCELLED
+                        run.error = "upstream failed/cancelled"
+                        run.finished_at = time.time()
+                        _STAGES_DONE[CANCELLED].inc()
+                        stream.registry().stage_finished(run.token)
+                        continue
+                    if self._eligible_locked(run):
+                        run.state = RUNNING
+                        run.started_at = time.time()
+                        observe.log(f"pipeline: stage {run.spec.id} "
+                                    f"({run.spec.tool}) started",
+                                    stage="pipeline")
+                        th = ctx_thread(self._run_stage, (run,),
+                                        name=f"bst-dag-{run.spec.id}")
+                        th.start()
+                        threads.append(th)
+                if all(r.state in _TERMINAL for r in self.runs.values()):
+                    break
+                self._changed.wait(0.2)
+                if _cancel.cancelled():
+                    # the surrounding job (a `bst serve` cancel, a daemon
+                    # drain) was poisoned: poison every stage and keep
+                    # looping until they unwind
+                    for run in self.runs.values():
+                        run.cancel.cancel()
+                        if run.state == PENDING:
+                            run.state = CANCELLED
+                            run.error = "pipeline cancelled"
+                            run.finished_at = time.time()
+                            _STAGES_DONE[CANCELLED].inc()
+                            stream.registry().stage_finished(run.token)
+        for th in threads:
+            th.join()
+
+
+def run_pipeline(spec: PipelineSpec | dict | str, *,
+                 workdir: str | None = None,
+                 keep_intermediates: bool = False) -> PipelineResult:
+    """Execute a pipeline spec (a :class:`PipelineSpec`, a spec dict, or
+    a path to a spec JSON file). Returns the :class:`PipelineResult`;
+    raises :class:`dag.spec.SpecError` on a malformed spec. Stage
+    failures do NOT raise — they are reported per stage with
+    ``result.ok`` False."""
+    if isinstance(spec, str):
+        if workdir is None:
+            workdir = os.path.dirname(os.path.abspath(spec)) or "."
+        spec = PipelineSpec.load(spec)
+    elif isinstance(spec, dict):
+        spec = PipelineSpec.from_dict(spec)
+    else:
+        spec.validate()
+    workdir = os.path.abspath(workdir or os.getcwd())
+
+    from ..parallel.distributed import world
+
+    if world()[1] > 1:
+        raise SpecError(
+            "bst pipeline is single-process: the block exchange and "
+            "memory:// elision live in process memory (run the one-shot "
+            "tools for multi-host work)")
+
+    run_id = _new_run_id()
+    spec.resolve(workdir, keep_intermediates, run_id)
+    ex = _Executor(spec, run_id)
+
+    edges = []
+    for name, ds in spec.datasets.items():
+        consumers = {ex.runs[c].token for c in spec.consumers_of(name)}
+        producers = {ex.runs[p].token for p in spec.producers_of(name)}
+        if not consumers and not ds.elided:
+            continue  # nothing to gate, nothing to account
+        edges.append(stream.EdgeState(
+            name, ds.resolved, producers, consumers,
+            elided=ds.elided, stream=ds.stream))
+    elided_roots = [ds.resolved for ds in spec.datasets.values()
+                    if ds.elided]
+    temp_roots = [ds.resolved for ds in spec.datasets.values()
+                  if ds.ephemeral and not keep_intermediates
+                  and not ds.elided]
+    kept = [ds.resolved for ds in spec.datasets.values()
+            if ds.ephemeral and keep_intermediates]
+    _CONTAINERS_ELIDED.inc(len(elided_roots))
+
+    reg = stream.registry()
+    reg.register(edges)
+    t0 = time.time()
+    observe.log(f"pipeline {spec.name}: {len(spec.stages)} stages, "
+                f"{len(edges)} edges "
+                f"({len(elided_roots)} container(s) elided to memory)",
+                stage="pipeline")
+    try:
+        ex.run()
+    finally:
+        reg.unregister(edges)
+        # ephemeral lifecycle: cleaned on success AND on failure/cancel —
+        # a half-written elided tree must never outlive its run
+        with profiling.span("dag.cleanup"):
+            for root in [*elided_roots, *temp_roots]:
+                _remove_container(root)
+            for root in temp_roots:
+                parent = os.path.dirname(root)
+                if os.path.basename(parent).startswith(".bst-dag-tmp-"):
+                    try:
+                        os.rmdir(parent)
+                    except OSError:
+                        pass
+
+    seconds = time.time() - t0
+    stage_rows = [ex.runs[s.id].summary() for s in spec.stages]
+    edge_rows = [e.summary() for e in edges]
+    ok = all(r["state"] == DONE for r in stage_rows)
+    observe.progress.record_stage(
+        "pipeline",
+        done=sum(1 for r in stage_rows if r["state"] == DONE),
+        total=len(stage_rows),
+        name=spec.name,
+        seconds=round(seconds, 3),
+        blocks_streamed=sum(e["blocks_streamed"] for e in edge_rows),
+        bytes_elided=sum(e["bytes_elided"] for e in edge_rows),
+        bytes_reread=sum(e["bytes_reread"] for e in edge_rows),
+        containers_elided=len(elided_roots),
+    )
+    return PipelineResult(
+        name=spec.name, ok=ok, seconds=seconds, stages=stage_rows,
+        edges=edge_rows, containers_elided=len(elided_roots),
+        kept_intermediates=kept)

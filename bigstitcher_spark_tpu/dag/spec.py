@@ -1,0 +1,314 @@
+"""Pipeline specs: stage nodes (existing ``bst`` tools) + dataset edges.
+
+A spec is plain JSON (or the equivalent Python dicts / dataclasses):
+
+.. code-block:: json
+
+    {
+      "name": "resave-fuse-downsample-detect",
+      "datasets": {
+        "resaved": {"path": "resaved.n5", "ephemeral": true},
+        "fused":   {"path": "fused.n5"}
+      },
+      "stages": [
+        {"id": "resave", "tool": "resave",
+         "args": ["-x", "/data/dataset.xml", "-o", "@resaved",
+                  "-xo", "@workdir/resaved.xml", "--N5"],
+         "writes": ["resaved"]},
+        {"id": "fuse", "tool": "affine-fusion", "args": ["-o", "@fused"],
+         "after": ["create"], "reads": ["resaved"], "writes": ["fused"]}
+      ]
+    }
+
+- ``datasets`` are the edges: a stage listing a name in ``writes`` is its
+  producer, in ``reads`` a consumer. Streamed edges (the default) gate
+  consumer reads at output-block granularity and hand blocks over in
+  memory; ``"stream": false`` turns the edge into a plain barrier
+  (consumer waits for the producer to finish).
+- ``"ephemeral": true`` marks an intermediate container: unless the run
+  keeps intermediates, it is elided to an in-process ``memory://`` root
+  (``"backing": "disk"`` spills to a run-scoped temp dir instead, e.g.
+  for intermediates larger than RAM) and is cleaned up on success AND on
+  failure/cancel — no orphaned half-written trees.
+- ``@name`` tokens in ``args`` substitute the dataset's resolved path;
+  ``@workdir`` the run's working directory. Everything else passes to
+  the tool verbatim.
+- ``after`` adds explicit barrier edges with no dataset (e.g. a stage
+  that needs a file a predecessor writes outside any container, like a
+  rewired XML).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+from ..io.uris import has_scheme
+
+# the serve surface must not nest, and a pipeline inside a pipeline is a
+# recursion bomb, not a workflow
+BLOCKED_TOOLS = {"serve", "submit", "jobs", "cancel", "pipeline"}
+
+_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_.\-]*$")
+_TOKEN_RE = re.compile(r"@([A-Za-z_][A-Za-z0-9_.\-]*)")
+_BACKINGS = ("memory", "disk")
+
+
+class SpecError(ValueError):
+    """The pipeline spec is malformed (unknown refs, cycles, bad tools)."""
+
+
+@dataclass
+class DatasetSpec:
+    """One named container edge of the pipeline."""
+
+    name: str
+    path: str | None = None
+    ephemeral: bool = False
+    stream: bool = True
+    backing: str = "memory"      # ephemeral only: "memory" | "disk"
+    resolved: str | None = None  # filled by PipelineSpec.resolve()
+    elided: bool = False         # resolved to a memory:// root
+
+
+@dataclass
+class StageSpec:
+    """One stage node: an existing ``bst`` tool invocation."""
+
+    id: str
+    tool: str
+    args: list[str] = field(default_factory=list)
+    after: list[str] = field(default_factory=list)
+    reads: list[str] = field(default_factory=list)
+    writes: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PipelineSpec:
+    name: str
+    stages: list[StageSpec]
+    datasets: dict[str, DatasetSpec]
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def from_dict(d: dict) -> "PipelineSpec":
+        if not isinstance(d, dict):
+            raise SpecError("pipeline spec must be a JSON object")
+        datasets: dict[str, DatasetSpec] = {}
+        for name, ds in (d.get("datasets") or {}).items():
+            ds = ds or {}
+            if not isinstance(ds, dict):
+                raise SpecError(f"dataset {name!r} must be an object")
+            datasets[str(name)] = DatasetSpec(
+                name=str(name),
+                path=ds.get("path"),
+                ephemeral=bool(ds.get("ephemeral", False)),
+                stream=bool(ds.get("stream", True)),
+                backing=str(ds.get("backing", "memory")),
+            )
+        stages = []
+        for s in (d.get("stages") or []):
+            if not isinstance(s, dict):
+                raise SpecError("each stage must be an object")
+            stages.append(StageSpec(
+                id=str(s.get("id", "")),
+                tool=str(s.get("tool", "")),
+                args=[str(a) for a in (s.get("args") or [])],
+                after=[str(a) for a in (s.get("after") or [])],
+                reads=[str(a) for a in (s.get("reads") or [])],
+                writes=[str(a) for a in (s.get("writes") or [])],
+            ))
+        spec = PipelineSpec(name=str(d.get("name") or "pipeline"),
+                            stages=stages, datasets=datasets)
+        spec.validate()
+        return spec
+
+    @staticmethod
+    def load(path: str) -> "PipelineSpec":
+        with open(path, encoding="utf-8") as f:
+            try:
+                d = json.load(f)
+            except ValueError as e:
+                raise SpecError(f"{path}: not valid JSON ({e})") from e
+        return PipelineSpec.from_dict(d)
+
+    # -- validation ---------------------------------------------------------
+
+    def validate(self) -> None:
+        if not self.stages:
+            raise SpecError("pipeline has no stages")
+        ids = [s.id for s in self.stages]
+        if len(set(ids)) != len(ids):
+            dup = sorted({i for i in ids if ids.count(i) > 1})
+            raise SpecError(f"duplicate stage id(s): {dup}")
+        for name, ds in self.datasets.items():
+            if not _NAME_RE.match(name) or name == "workdir":
+                raise SpecError(f"bad dataset name {name!r} (identifier, "
+                                f"not 'workdir')")
+            if ds.backing not in _BACKINGS:
+                raise SpecError(f"dataset {name!r}: backing must be one of "
+                                f"{_BACKINGS}, got {ds.backing!r}")
+        from ..cli.main import cli as _cli
+
+        for s in self.stages:
+            if not s.id or not _NAME_RE.match(s.id):
+                raise SpecError(f"bad stage id {s.id!r}")
+            if s.tool in BLOCKED_TOOLS or s.tool not in _cli.commands:
+                raise SpecError(f"stage {s.id!r}: unknown or unservable "
+                                f"tool {s.tool!r}")
+            for ref in s.after:
+                if ref not in ids:
+                    raise SpecError(f"stage {s.id!r}: unknown stage "
+                                    f"{ref!r} in after")
+                if ref == s.id:
+                    raise SpecError(f"stage {s.id!r} lists itself in after")
+            for name in [*s.reads, *s.writes]:
+                if name not in self.datasets:
+                    raise SpecError(f"stage {s.id!r}: undeclared dataset "
+                                    f"{name!r}")
+            for arg in s.args:
+                for m in _TOKEN_RE.finditer(arg):
+                    tokname = m.group(1)
+                    if tokname != "workdir" and tokname not in self.datasets:
+                        raise SpecError(
+                            f"stage {s.id!r}: arg {arg!r} references "
+                            f"undeclared dataset @{tokname}")
+        for name in self.datasets:
+            if not self.producers_of(name):
+                raise SpecError(
+                    f"dataset {name!r} has no producer stage (external "
+                    f"inputs are plain args, not datasets)")
+        self._check_cycles()
+
+    def producers_of(self, name: str) -> list[str]:
+        return [s.id for s in self.stages if name in s.writes]
+
+    def consumers_of(self, name: str) -> list[str]:
+        return [s.id for s in self.stages if name in s.reads]
+
+    def barrier_parents(self, stage: StageSpec) -> set[str]:
+        """Stages that must FINISH before ``stage`` starts: explicit
+        ``after`` edges plus producers of its non-streamed inputs."""
+        parents = set(stage.after)
+        for name in stage.reads:
+            if not self.datasets[name].stream:
+                parents.update(self.producers_of(name))
+        parents.discard(stage.id)
+        return parents
+
+    def stream_parents(self, stage: StageSpec) -> set[str]:
+        """Producers of ``stage``'s streamed inputs: they only need to
+        have STARTED (block gating covers the rest)."""
+        parents: set[str] = set()
+        for name in stage.reads:
+            if self.datasets[name].stream:
+                parents.update(self.producers_of(name))
+        parents.discard(stage.id)
+        return parents
+
+    def parents(self, stage: StageSpec) -> set[str]:
+        return self.barrier_parents(stage) | self.stream_parents(stage)
+
+    def _check_cycles(self) -> None:
+        by_id = {s.id: s for s in self.stages}
+        state: dict[str, int] = {}   # 0 visiting, 1 done
+
+        def visit(sid, trail):
+            if state.get(sid) == 1:
+                return
+            if state.get(sid) == 0:
+                cyc = trail[trail.index(sid):] + [sid]
+                raise SpecError(f"dependency cycle: {' -> '.join(cyc)}")
+            state[sid] = 0
+            for p in sorted(self.parents(by_id[sid])):
+                visit(p, trail + [sid])
+            state[sid] = 1
+
+        for s in self.stages:
+            visit(s.id, [])
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolve(self, workdir: str, keep_intermediates: bool,
+                run_id: str) -> None:
+        """Fill every dataset's ``resolved`` path and substitute ``@name``
+        / ``@workdir`` tokens in the stage args. Ephemeral datasets elide
+        to ``memory://bst-dag-<run>/<name>`` (or a run-scoped temp dir
+        with disk backing) unless intermediates are kept."""
+        workdir = os.path.abspath(workdir)
+        for ds in self.datasets.values():
+            if ds.ephemeral and not keep_intermediates:
+                if ds.backing == "memory":
+                    ds.resolved = f"memory://bst-dag-{run_id}/{ds.name}"
+                    ds.elided = True
+                else:
+                    ds.resolved = os.path.join(
+                        workdir, f".bst-dag-tmp-{run_id}", ds.name)
+                    ds.elided = False
+            else:
+                p = ds.path or ds.name
+                ds.resolved = p if has_scheme(p) else \
+                    os.path.abspath(os.path.join(workdir, p))
+                ds.elided = False
+
+        def sub(arg: str) -> str:
+            def repl(m):
+                tokname = m.group(1)
+                if tokname == "workdir":
+                    return workdir
+                return self.datasets[tokname].resolved
+
+            return _TOKEN_RE.sub(repl, arg)
+
+        for s in self.stages:
+            s.args = [sub(a) for a in s.args]
+
+
+def example_spec(xml: str, prefix: str = "pipeline") -> dict:
+    """The canonical streamed resave -> fuse -> downsample -> detect
+    pipeline for a project XML, as a plain spec dict (what ``bst pipeline
+    init`` writes). All paths are absolute so the spec runs identically
+    from a shell, through ``bst pipeline run``, or submitted to a `bst
+    serve` daemon with a different working directory."""
+    xml = os.path.abspath(xml)
+    root = os.path.dirname(xml)
+    rexml = os.path.join(root, f"{prefix}-resaved.xml")
+    return {
+        "name": f"{prefix}-resave-fuse-downsample-detect",
+        "datasets": {
+            # the classic intermediate: consumed by fusion AND detection,
+            # then dead — elided to memory unless --keep-intermediates
+            "resaved": {"path": os.path.join(root, f"{prefix}-resaved.n5"),
+                        "ephemeral": True},
+            "fused": {"path": os.path.join(root, f"{prefix}-fused.n5")},
+        },
+        "stages": [
+            {"id": "resave", "tool": "resave",
+             "args": ["-x", xml, "-xo", rexml, "-o", "@resaved", "--N5"],
+             "writes": ["resaved"]},
+            # barrier on resave: the rewired XML is written when the
+            # resave commits (it is a file, not a container edge)
+            {"id": "create", "tool": "create-fusion-container",
+             "args": ["-x", rexml, "-o", "@fused", "-s", "N5",
+                      "-d", "UINT16", "--minIntensity", "0",
+                      "--maxIntensity", "65535"],
+             "after": ["resave"]},
+            {"id": "fuse", "tool": "affine-fusion",
+             "args": ["-o", "@fused"],
+             "after": ["create"], "reads": ["resaved"],
+             "writes": ["fused"]},
+            # streamed: starts with fusion and consumes fused s0 blocks
+            # the moment they are published
+            {"id": "downsample", "tool": "downsample",
+             "args": ["-i", "@fused", "-di", "ch0tp0/s0", "-ds", "2,2,1"],
+             "reads": ["fused"], "writes": ["fused"]},
+            # independent consumer branch of the elided intermediate
+            {"id": "detect", "tool": "detect-interestpoints",
+             "args": ["-x", rexml, "-l", "beads", "-s", "1.8",
+                      "-t", "0.008", "-dsxy", "1", "-dsz", "1"],
+             "after": ["resave"], "reads": ["resaved"]},
+        ],
+    }

@@ -1,0 +1,467 @@
+"""Block-granular streaming exchange between pipeline stages.
+
+The one-shot pipeline bounces every byte between stages off a container:
+fusion writes full N5/zarr trees that downsample and detection re-read
+moments later (the dominant cost after the kernels, PERF §3g-k). This
+module replaces that round-trip for stages running in ONE process under
+the DAG executor (dag/executor.py): it hooks the two choke points every
+driver already funnels through — ``Dataset.read`` / ``Dataset.write``
+(io/chunkstore.py) — so no per-driver callback plumbing is needed.
+
+Per streamed edge (a named dataset with producer and consumer stages):
+
+- **readiness** — a producer's write marks the storage-chunk positions it
+  fully covered as complete; a consumer's read of a not-yet-covered box
+  blocks until the covering blocks land (or every producer finished —
+  blocks a producer legitimately never writes, e.g. fusion's empty
+  blocks, resolve then). This is scheduling at *output-block*
+  granularity: the consumer is already running while the producer still
+  is.
+- **in-memory handoff** — the write is also split into its decoded
+  chunks and pushed into the process-wide decoded-chunk LRU
+  (io/chunkcache.py), so the consumer's gated read is served from memory
+  with zero container decode. With the container itself elided to a
+  ``memory://`` root the edge never touches disk at all.
+- **backpressure** — published-but-unconsumed bytes are charged against
+  ``BST_DAG_EXCHANGE_BYTES``; an over-budget producer stalls until
+  consumers drain. One escape hatch prevents the classic reorder
+  deadlock: while any consumer is *waiting* for unpublished blocks the
+  producer never stalls (a starved consumer cannot drain the ledger).
+- **accounting** — every consumer read of a streamed edge is attributed
+  as elided (served by the handoff) or re-read (container decode), per
+  edge and in the ``bst_dag_*`` process metrics, so `bst trace-report`
+  and the bench ``pipeline`` extra can show exactly how many
+  intermediate bytes never made the round trip.
+
+Everything here is inert until the executor registers edges: outside a
+pipeline run the chunkstore hot paths pay one list-load.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import os
+import threading
+import time
+
+import numpy as np
+
+from .. import config, profiling
+from ..io import chunkcache, chunkstore
+from ..io.uris import has_scheme
+from ..observe import metrics as _metrics
+from ..observe import trace as _trace
+from ..utils import cancel as _cancel
+
+_BLOCKS = _metrics.counter("bst_dag_blocks_streamed_total")
+_ELIDED = _metrics.counter("bst_dag_bytes_elided_total")
+_REREAD = _metrics.counter("bst_dag_bytes_reread_total")
+_EPH_WRITE = _metrics.counter("bst_dag_ephemeral_write_bytes_total")
+_EXCHANGE = _metrics.gauge("bst_dag_exchange_bytes")
+_QUEUE = _metrics.gauge("bst_dag_exchange_blocks")
+_STALL = _metrics.counter("bst_dag_producer_stall_seconds_total")
+_WAIT = _metrics.counter("bst_dag_consumer_wait_seconds_total")
+
+# wake-up tick for gate/stall waits: long enough to be free, short enough
+# that cancellation (polled on every tick) stays responsive
+_TICK_S = 0.2
+
+
+class StageToken:
+    """Identity of one running stage. Carried in a contextvar (and into
+    every worker pool the stage spawns, via utils.threads), so the
+    chunkstore hooks know WHICH stage is reading or writing. Identity is
+    the object itself — ids may repeat across concurrent runs."""
+
+    __slots__ = ("stage_id", "run_id")
+
+    def __init__(self, stage_id: str, run_id: str):
+        self.stage_id = stage_id
+        self.run_id = run_id
+
+    def __repr__(self):
+        return f"StageToken({self.stage_id!r}@{self.run_id})"
+
+
+_current_stage: contextvars.ContextVar[StageToken | None] = \
+    contextvars.ContextVar("bst-dag-stage", default=None)
+
+
+def current_stage() -> StageToken | None:
+    return _current_stage.get()
+
+
+@contextlib.contextmanager
+def stage_scope(token: StageToken):
+    """Make ``token`` the ambient stage for this context (and, via
+    utils.threads, every worker thread spawned under it)."""
+    tok = _current_stage.set(token)
+    try:
+        yield token
+    finally:
+        _current_stage.reset(tok)
+
+
+def norm_root(root) -> str:
+    """Canonical edge key of a container root: URIs verbatim, local paths
+    absolute — both the executor (registering the resolved spec path) and
+    the hooks (seeing whatever string the driver opened the store with)
+    normalize through here so they cannot disagree."""
+    r = str(root)
+    return r if has_scheme(r) else os.path.abspath(r)
+
+
+class EdgeState:
+    """One pipeline dataset edge: which stages produce and consume it,
+    whether it streams (block gating + handoff) and whether its container
+    is elided to memory, plus this run's authoritative totals. All
+    mutable counters are guarded by the owning registry's lock."""
+
+    def __init__(self, name: str, root: str, producers, consumers,
+                 elided: bool = False, stream: bool = True):
+        self.name = name
+        self.root = norm_root(root)
+        self.producers: frozenset[StageToken] = frozenset(producers)
+        self.consumers: frozenset[StageToken] = frozenset(consumers)
+        self.elided = bool(elided)
+        self.stream = bool(stream)
+        # per-run totals (filled under the registry lock)
+        self.blocks_published = 0
+        self.bytes_published = 0
+        self.bytes_elided = 0
+        self.bytes_reread = 0
+        self.stall_s = 0.0
+        self.wait_s = 0.0
+
+    def summary(self) -> dict:
+        return {
+            "edge": self.name,
+            "root": self.root,
+            "elided": self.elided,
+            "stream": self.stream,
+            "blocks_streamed": self.blocks_published,
+            "bytes_published": self.bytes_published,
+            "bytes_elided": self.bytes_elided,
+            "bytes_reread": self.bytes_reread,
+            "producer_stall_s": round(self.stall_s, 3),
+            "consumer_wait_s": round(self.wait_s, 3),
+        }
+
+
+def _geometry(ds):
+    """(block_size, dims) of a dataset, or None when it has no usable
+    chunk grid (the hooks then pass the IO through ungated)."""
+    try:
+        block = tuple(int(b) for b in ds.block_size)
+        dims = tuple(int(d) for d in ds.shape)
+    except Exception:
+        return None
+    if not block or len(block) != len(dims) or any(b <= 0 for b in block):
+        return None
+    return block, dims
+
+
+def _ds_key(ds):
+    """(normalized root, dataset path) of a Dataset, or None when it has
+    no stable identity."""
+    try:
+        root, path = ds._cache_key()
+    except Exception:
+        return None
+    if root is None:
+        return None
+    return norm_root(root), str(path).strip("/")
+
+
+def _touched_positions(offset, shape, block):
+    grids = [range(int(offset[d]) // block[d],
+                   (int(offset[d]) + int(shape[d]) - 1) // block[d] + 1)
+             for d in range(len(block))]
+    return list(itertools.product(*grids))
+
+
+def _covered_positions(offset, shape, block, dims):
+    """Chunk positions whose full (array-clipped) extent lies inside the
+    written box — only those may be marked complete / handed off; a
+    partially covered interior chunk stays pending until the producer
+    finishes (the drivers' grids are chunk-aligned, so in practice this
+    is every touched chunk)."""
+    nd = len(block)
+    out = []
+    for pos in _touched_positions(offset, shape, block):
+        lo = [pos[d] * block[d] for d in range(nd)]
+        hi = [min(lo[d] + block[d], dims[d]) for d in range(nd)]
+        if all(lo[d] >= int(offset[d])
+               and hi[d] <= int(offset[d]) + int(shape[d])
+               for d in range(nd)):
+            out.append(pos)
+    return out
+
+
+def _chunk_slices(pos, offset, block, dims):
+    nd = len(block)
+    return tuple(
+        slice(pos[d] * block[d] - int(offset[d]),
+              min((pos[d] + 1) * block[d], dims[d]) - int(offset[d]))
+        for d in range(nd))
+
+
+class StreamRegistry:
+    """Process-wide edge registry + block exchange. One instance serves
+    every concurrent pipeline run (runs register/unregister their own
+    edges; stage tokens are object-identity so ids never collide)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._edges: dict[str, EdgeState] = {}          # root -> edge
+        self._coverage: dict[tuple, set] = {}           # (root, path) -> pos
+        self._pending: dict[tuple, list] = {}           # (root,path,pos) ->
+        #                                       [nbytes, {consumer tokens}]
+        self._finished: set[StageToken] = set()
+        self._exchange_bytes = 0
+        self._gate_waiters = 0
+
+    # -- lifecycle (executor side) -----------------------------------------
+
+    def register(self, edges) -> None:
+        with self._cond:
+            for e in edges:
+                self._edges[e.root] = e
+            if self._edges:
+                # installed under the lock: a concurrent unregister of the
+                # LAST other run must not race this install away
+                chunkstore.set_dag_hooks(self)
+
+    def unregister(self, edges) -> None:
+        with self._cond:
+            for e in edges:
+                if self._edges.get(e.root) is e:
+                    del self._edges[e.root]
+                for key in [k for k in self._coverage if k[0] == e.root]:
+                    del self._coverage[key]
+                for key in [k for k in self._pending if k[0] == e.root]:
+                    nbytes, _ = self._pending.pop(key)
+                    self._exchange_bytes -= nbytes
+                self._finished -= e.producers | e.consumers
+            self._update_gauges_locked()
+            if not self._edges:
+                chunkstore.set_dag_hooks(None)
+            self._cond.notify_all()
+
+    def stage_finished(self, token: StageToken) -> None:
+        """A stage reached a terminal state: release every exchange claim
+        it still held and wake gate/stall waiters (producers-done and
+        consumers-alive conditions may both have flipped)."""
+        with self._cond:
+            self._finished.add(token)
+            for key in list(self._pending):
+                nbytes, owed = self._pending[key]
+                if token in owed:
+                    owed.discard(token)
+                    if not owed:
+                        del self._pending[key]
+                        self._exchange_bytes -= nbytes
+            self._update_gauges_locked()
+            self._cond.notify_all()
+
+    def _update_gauges_locked(self) -> None:
+        _EXCHANGE.set(self._exchange_bytes)
+        _QUEUE.set(len(self._pending))
+
+    # -- chunkstore hooks ---------------------------------------------------
+
+    def gate(self, ds, offset, shape) -> None:
+        """Block a consumer stage's read until the producer has written
+        every storage chunk the box touches (or all producers finished).
+        No-op for non-edge datasets, non-consumer stages, and reads the
+        hook cannot reason about."""
+        if not self._edges:
+            return
+        tok = _current_stage.get()
+        if tok is None:
+            return
+        key = _ds_key(ds)
+        if key is None:
+            return
+        root, path = key
+        edge = self._edges.get(root)
+        if edge is None or not edge.stream or tok not in edge.consumers:
+            return
+        geo = _geometry(ds)
+        if geo is None:
+            return
+        block, _dims = geo
+        if len(block) != len(tuple(offset)):
+            return
+        need = _touched_positions(offset, shape, block)
+        with self._cond:
+            if not self._missing_locked(root, path, need, edge, tok):
+                self._consume_locked(edge, tok, root, path, need)
+                return
+            with profiling.span("dag.wait", stage=edge.name):
+                t0 = time.perf_counter()
+                self._gate_waiters += 1
+                try:
+                    while self._missing_locked(root, path, need, edge, tok):
+                        self._cond.wait(_TICK_S)
+                        _cancel.check("dag gate")
+                finally:
+                    self._gate_waiters -= 1
+                    dt = time.perf_counter() - t0
+                    edge.wait_s += dt
+                    _WAIT.inc(dt)
+                    self._cond.notify_all()
+            self._consume_locked(edge, tok, root, path, need)
+
+    def _missing_locked(self, root, path, need, edge, tok) -> bool:
+        cov = self._coverage.get((root, path))
+        if cov is not None and all(p in cov for p in need):
+            return False
+        # blocks a producer never writes (fusion's empty blocks) resolve
+        # when every OTHER producer is terminal — the data then simply is
+        # what the container holds
+        return not all(p in self._finished
+                       for p in edge.producers if p is not tok)
+
+    def _consume_locked(self, edge, tok, root, path, need) -> None:
+        drained = False
+        for pos in need:
+            ent = self._pending.get((root, path, pos))
+            if ent is not None and tok in ent[1]:
+                ent[1].discard(tok)
+                if not ent[1]:
+                    del self._pending[(root, path, pos)]
+                    self._exchange_bytes -= ent[0]
+                drained = True
+        if drained:
+            self._update_gauges_locked()
+            self._cond.notify_all()
+
+    def on_write(self, ds, data, offset) -> None:
+        """Producer side: mark covered chunks complete, hand their decoded
+        bytes to the chunk cache, charge the exchange, stall over budget."""
+        if not self._edges:
+            return
+        key = _ds_key(ds)
+        if key is None:
+            return
+        root, path = key
+        edge = self._edges.get(root)
+        if edge is None:
+            return
+        if edge.elided:
+            _EPH_WRITE.inc(int(data.nbytes))
+        if not edge.stream:
+            return
+        tok = _current_stage.get()
+        if tok is None or tok not in edge.producers:
+            # only DECLARED producers publish completion: a foreign write
+            # into the same root (another daemon job, an init-style stage
+            # not in `writes`) must never unblock a gated consumer with
+            # bytes the real producer has not written yet
+            return
+        geo = _geometry(ds)
+        if geo is None:
+            return
+        block, dims = geo
+        if len(block) != data.ndim:
+            return
+        covered = _covered_positions(offset, data.shape, block, dims)
+        if not covered:
+            return
+        # write-through handoff: the consumer's gated read finds these in
+        # the decoded-chunk cache and never decodes the container (copies,
+        # so a driver reusing its write buffer cannot corrupt the cache)
+        if chunkcache.enabled() and ds._cacheable():
+            dkey = ds._cache_key()
+            sig = ds._cache_sig()
+            cc = chunkcache.get_cache()
+            for pos in covered:
+                piece = np.array(
+                    data[_chunk_slices(pos, offset, block, dims)], copy=True)
+                cc.put((dkey, sig, pos), piece, record_miss=False)
+        nbytes = int(data.nbytes)
+        per = max(1, nbytes // len(covered))
+        if _trace.enabled():
+            _trace.instant("dag.publish", stage=edge.name, nbytes=nbytes,
+                           item=tuple(int(o) for o in offset))
+        with self._cond:
+            cov = self._coverage.setdefault((root, path), set())
+            fresh = [p for p in covered if p not in cov]
+            cov.update(covered)
+            if fresh:
+                edge.blocks_published += len(fresh)
+                edge.bytes_published += per * len(fresh)
+                _BLOCKS.inc(len(fresh))
+                owed = {c for c in edge.consumers
+                        if c not in self._finished and c is not tok}
+                if owed:
+                    for p in fresh:
+                        self._pending[(root, path, p)] = [per, set(owed)]
+                    self._exchange_bytes += per * len(fresh)
+                self._update_gauges_locked()
+            self._cond.notify_all()
+            self._stall_locked(edge, tok)
+
+    def _stall_locked(self, edge, tok) -> None:
+        """Backpressure: hold the producer while the exchange is over
+        budget AND some consumer is alive to drain it AND no consumer is
+        starved waiting for unpublished blocks (stalling then would be
+        the textbook reorder deadlock — the producer must run)."""
+        budget = config.get_bytes("BST_DAG_EXCHANGE_BYTES")
+
+        def should_stall():
+            if not budget or self._exchange_bytes <= budget:
+                return False
+            if self._gate_waiters:
+                return False
+            return any(c not in self._finished and c is not tok
+                       for c in edge.consumers)
+
+        if not should_stall():
+            return
+        with profiling.span("dag.stall", stage=edge.name):
+            t0 = time.perf_counter()
+            try:
+                while should_stall():
+                    self._cond.wait(_TICK_S)
+                    _cancel.check("dag backpressure")
+            finally:
+                dt = time.perf_counter() - t0
+                edge.stall_s += dt
+                _STALL.inc(dt)
+
+    def account_read(self, ds, via: str, nbytes: int) -> None:
+        """Attribute a consumer's streamed-edge read bytes: ``cache`` =
+        served by the handoff (container re-read elided), anything else =
+        a container decode the streaming failed to elide."""
+        if not self._edges or not nbytes:
+            return
+        tok = _current_stage.get()
+        if tok is None:
+            return
+        key = _ds_key(ds)
+        if key is None:
+            return
+        edge = self._edges.get(key[0])
+        if edge is None or not edge.stream or tok not in edge.consumers:
+            return
+        with self._cond:
+            if via == "cache":
+                edge.bytes_elided += int(nbytes)
+            else:
+                edge.bytes_reread += int(nbytes)
+        if via == "cache":
+            _ELIDED.inc(int(nbytes))
+        else:
+            _REREAD.inc(int(nbytes))
+
+
+_REGISTRY = StreamRegistry()
+
+
+def registry() -> StreamRegistry:
+    return _REGISTRY

@@ -91,10 +91,16 @@ class ChunkCache:
         _HIT_BYTES.inc(arr.nbytes)
         return arr
 
-    def put(self, key: tuple, arr: np.ndarray) -> None:
+    def put(self, key: tuple, arr: np.ndarray,
+            record_miss: bool = True) -> None:
+        """Insert a decoded chunk. ``record_miss=False`` marks a
+        write-through insertion (the streaming DAG handoff populating the
+        cache from a producer's write) rather than a decode after a cache
+        miss, so the miss-byte counter keeps meaning what it says."""
         budget = budget_bytes()
         if arr.nbytes > budget:
-            _MISS_BYTES.inc(arr.nbytes)
+            if record_miss:
+                _MISS_BYTES.inc(arr.nbytes)
             return
         arr = np.ascontiguousarray(arr)
         arr.setflags(write=False)
@@ -112,7 +118,8 @@ class ChunkCache:
                 self._bytes -= v.nbytes
                 evicted.append(v.nbytes)
             self._update_gauges()
-        _MISS_BYTES.inc(arr.nbytes)
+        if record_miss:
+            _MISS_BYTES.inc(arr.nbytes)
         for nb in evicted:
             _EVICTIONS.inc()
             _EVICT_BYTES.inc(nb)

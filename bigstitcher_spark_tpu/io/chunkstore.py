@@ -65,6 +65,21 @@ def _record_io(op: str, via: str, nbytes: int, dataset: str) -> None:
         _events.emit(f"io.{op}", path=via, bytes=int(nbytes),
                      dataset=dataset)
 
+# streaming stage-DAG hooks (dag/stream.StreamRegistry): installed only
+# while a pipeline run has streamed edges registered, None otherwise, so
+# one list-load guards every hot path. The registry gates consumer reads
+# on producer block completion, accounts handoff-vs-container bytes, and
+# publishes producer writes into the block exchange.
+_DAG_HOOKS: list = [None]
+
+
+def set_dag_hooks(hooks) -> None:
+    """Install (or with None remove) the streaming-DAG read/write hooks —
+    called by dag.stream when the first edge registers / the last one
+    unregisters."""
+    _DAG_HOOKS[0] = hooks
+
+
 # one shared Context so every open in this process sees the same caches and
 # the same in-process ``memory://`` store (tensorstore scopes the memory
 # kvstore to a Context; without sharing, each open would get an empty store)
@@ -317,9 +332,12 @@ class Dataset:
                 cc.put((dkey, sig, pos), chunk)
                 nb += fill(pos, chunk)
             copied[via] = copied.get(via, 0) + nb
+        hooks = _DAG_HOOKS[0]
         for via, nb in copied.items():
             if nb:
                 _record_io("read", via, nb, self.path)
+                if hooks is not None:
+                    hooks.account_read(self, via, nb)
         return out
 
     def _read_chunks(self, positions):
@@ -402,6 +420,12 @@ class Dataset:
 
     def read(self, offset: Sequence[int], shape: Sequence[int]) -> np.ndarray:
         """Read a box (xyz-first offset/shape) into a numpy array (xyz-first)."""
+        hooks = _DAG_HOOKS[0]
+        if hooks is not None:
+            # streaming pipelines: a consumer stage's read of a streamed
+            # edge blocks here until the producer has written the covering
+            # blocks (or finished); everyone else passes straight through
+            hooks.gate(self, offset, shape)
         if chunkcache.enabled() and self._cacheable():
             cached = self._cached_read(offset, shape)
             if cached is not None:
@@ -409,6 +433,8 @@ class Dataset:
         native = self._native_read(offset, shape)
         if native is not None:
             _record_io("read", "native", native.nbytes, self.path)
+            if hooks is not None:
+                hooks.account_read(self, "native", native.nbytes)
             return native
         if self._ts is None:
             raise ValueError(
@@ -423,6 +449,8 @@ class Dataset:
             via = "h5py"
         data = np.asarray(data)
         _record_io("read", via, data.nbytes, self.path)
+        if hooks is not None:
+            hooks.account_read(self, via, data.nbytes)
         return data.transpose(tuple(range(data.ndim))[::-1]) if self.reversed_axes else data
 
     def _native_read(self, offset: Sequence[int],
@@ -498,28 +526,37 @@ class Dataset:
         io.native_blockio) when available."""
         shape = data.shape
         try:
-            if (self._native_write(data, offset)
-                    or self._native_write_zarr(data, offset)):
-                _record_io("write", "native", data.nbytes, self.path)
-                return
-            if self._ts is None:
-                raise ValueError(
-                    f"{self.path}: native-only dataset (lz4) — writes must "
-                    "be block-aligned and dtype-matched")
-            sel = self._sel(offset, data.shape)
-            if self.reversed_axes:
-                data = data.transpose(tuple(range(data.ndim))[::-1])
-            if hasattr(self._ts, "read"):
-                self._ts[sel].write(np.ascontiguousarray(data)).result()
-                via = "tensorstore"
-            else:
-                self._ts[sel] = data
-                via = "h5py"
-            _record_io("write", via, data.nbytes, self.path)
+            self._write_impl(data, offset)
         finally:
             # drop exactly the cached chunks this box covers (finally: a
             # partially-applied failed write must not leave stale entries)
             self._invalidate_box(offset, shape)
+        hooks = _DAG_HOOKS[0]
+        if hooks is not None:
+            # streaming pipelines: publish the completed block (coverage,
+            # write-through handoff, backpressure) — AFTER the invalidation
+            # above so the handoff's cache entries survive it
+            hooks.on_write(self, data, offset)
+
+    def _write_impl(self, data: np.ndarray, offset: Sequence[int]) -> None:
+        if (self._native_write(data, offset)
+                or self._native_write_zarr(data, offset)):
+            _record_io("write", "native", data.nbytes, self.path)
+            return
+        if self._ts is None:
+            raise ValueError(
+                f"{self.path}: native-only dataset (lz4) — writes must "
+                "be block-aligned and dtype-matched")
+        sel = self._sel(offset, data.shape)
+        if self.reversed_axes:
+            data = data.transpose(tuple(range(data.ndim))[::-1])
+        if hasattr(self._ts, "read"):
+            self._ts[sel].write(np.ascontiguousarray(data)).result()
+            via = "tensorstore"
+        else:
+            self._ts[sel] = data
+            via = "h5py"
+        _record_io("write", via, data.nbytes, self.path)
 
     def _native_n5_eligible(self) -> str | None:
         """Shared native-codec eligibility gate for N5 reads AND writes:

@@ -89,6 +89,32 @@ METRICS: dict[str, str] = {
     "bst_serve_compile_warm_hits_total":
         "per-job warm compiled-fn bucket hits observed by the daemon "
         "(the amortized-compile win of a resident process)",
+    # streaming stage-DAG executor (dag/): producer->consumer block
+    # exchange that replaces intermediate-container round-trips
+    "bst_dag_blocks_streamed_total":
+        "output blocks published on streamed pipeline edges",
+    "bst_dag_bytes_elided_total":
+        "streamed-edge bytes consumers read from the in-memory handoff "
+        "(decoded-chunk cache) instead of re-reading the container",
+    "bst_dag_bytes_reread_total":
+        "streamed-edge bytes consumers had to decode from the container "
+        "(handoff miss — evicted or never published)",
+    "bst_dag_ephemeral_write_bytes_total":
+        "bytes written to elided (memory-backed) intermediate containers "
+        "that never touch disk",
+    "bst_dag_exchange_bytes":
+        "published-but-unconsumed bytes in the block-exchange ledger",
+    "bst_dag_exchange_blocks":
+        "published-but-unconsumed blocks in the block-exchange ledger",
+    "bst_dag_producer_stall_seconds_total":
+        "seconds producers stalled on block-exchange backpressure",
+    "bst_dag_consumer_wait_seconds_total":
+        "seconds consumers waited for input blocks not yet produced",
+    "bst_dag_stages_completed_total":
+        "pipeline stages finished, labeled by terminal status",
+    "bst_dag_containers_elided_total":
+        "ephemeral intermediate containers elided to memory (never "
+        "materialized on disk)",
 }
 
 # Every trace/profiling SPAN name, declared exactly once — the same
@@ -143,6 +169,13 @@ SPANS: dict[str, str] = {
     "serve.submit": "a job was accepted into the queue (instant)",
     "serve.cancel": "a cancel request was applied to a job (instant)",
     "serve.shutdown": "the daemon began draining/shutting down (instant)",
+    # streaming stage-DAG executor (dag/executor.py, dag/stream.py)
+    "dag.stage": "one pipeline stage's full execution on its thread",
+    "dag.wait":
+        "a consumer stage blocked for input blocks not yet produced",
+    "dag.stall": "a producer stage blocked on block-exchange backpressure",
+    "dag.publish": "a producer published an output block (instant)",
+    "dag.cleanup": "ephemeral intermediate-container cleanup",
 }
 
 

@@ -47,13 +47,16 @@ def shutdown(socket_path: str | None = None, drain: bool = True) -> dict:
 def submit(socket_path: str | None, tool: str, args: list[str],
            *, priority: int = 0, share: str | None = None,
            overrides: dict | None = None, cost: float = 1.0,
+           after: list[str] | None = None,
            follow: bool = True, on_event=None,
            timeout: float | None = None) -> dict:
     """Submit one job. ``follow=True`` (default) blocks until the job
     finishes, calling ``on_event(record)`` for every streamed heartbeat,
     and returns the final ``done`` record (``exit_code``, ``state``,
     ``warm_compile_hits``, ``telemetry_dir``). ``follow=False`` returns
-    the ``accepted`` record immediately."""
+    the ``accepted`` record immediately. ``after`` lists parent job ids:
+    the job stays queued until they all succeed and cancels if any of
+    them fails or is cancelled."""
     s = protocol.connect(socket_path, timeout=timeout)
     try:
         f = s.makefile("rwb")
@@ -61,6 +64,7 @@ def submit(socket_path: str | None, tool: str, args: list[str],
             "op": "submit", "tool": tool, "args": list(args),
             "priority": priority, "share": share, "cost": cost,
             "overrides": overrides or {}, "follow": follow,
+            "after": list(after or []),
         })
         first = protocol.read_line(f)
         if first is None:

@@ -288,10 +288,16 @@ class Daemon:
                     "uptime_s": round(time.time() - self.started_at, 1),
                     "device": self.device_info})
             elif op == "jobs":
+                rows = []
+                for j in self.queue.jobs():
+                    d = j.describe()
+                    open_ids = self.queue.waiting_on(j.id)
+                    if open_ids:
+                        d["waiting_on"] = sorted(open_ids)
+                    rows.append(d)
                 protocol.send_line(f, {"event": "jobs",
                                        "daemon": self._status(),
-                                       "jobs": [j.describe() for j in
-                                                self.queue.jobs()]})
+                                       "jobs": rows})
             elif op == "cancel":
                 self._op_cancel(f, req)
             elif op == "shutdown":
@@ -345,12 +351,15 @@ class Daemon:
             return
         self.queue.cancel(job.id)
         _trace.instant("serve.cancel", item=job.id)
-        if job.state == CANCELLED and job.started_at is None:
-            # cancelled straight off the queue: no slot will ever notify
-            # this job's followers, so close their streams here
-            self._notify(job, {"event": "done", "job": job.id,
-                               "state": job.state, "exit_code": None})
-            job.waiters.clear()
+        # cancelled straight off the queue (the job itself, and any
+        # dependents its cancellation cascaded into): no slot will ever
+        # notify their followers, so close those streams here
+        for j in self.queue.jobs():
+            if j.state == CANCELLED and j.started_at is None and j.waiters:
+                self._notify(j, {"event": "done", "job": j.id,
+                                 "state": j.state, "exit_code": None,
+                                 "error": j.error})
+                j.waiters.clear()
         protocol.send_line(f, {"event": "cancelled", "job": job.id,
                                "state": job.state})
 
@@ -388,6 +397,7 @@ class Daemon:
             share=str(req.get("share") or "default"),
             overrides=ov,
             cost=float(req.get("cost") or 1.0),
+            after=[str(a) for a in (req.get("after") or [])],
         )
         job.telemetry_dir = os.path.join(self.jobs_root, jid)
         follow = bool(req.get("follow", True))
@@ -400,11 +410,20 @@ class Daemon:
         except RuntimeError as e:   # draining
             protocol.send_line(f, {"event": "error", "error": str(e)})
             return
+        except KeyError as e:       # unknown --after parent
+            protocol.send_line(f, {"event": "error", "error": str(e)})
+            return
         _trace.instant("serve.submit", item=jid)
         events.emit("serve.submit", job=jid, tool=tool, share=job.share,
-                    priority=job.priority)
+                    priority=job.priority, after=job.after)
         protocol.send_line(f, {"event": "accepted", "job": jid,
                                "telemetry_dir": job.telemetry_dir})
+        if job.state == CANCELLED:
+            # a parent had already failed/cancelled: terminal on arrival
+            self._notify(job, {"event": "done", "job": jid,
+                               "state": job.state, "exit_code": None,
+                               "error": job.error})
+            job.waiters.clear()
         if not follow:
             return
         while True:
@@ -516,13 +535,20 @@ class Daemon:
             pass
         if router is not None:
             router.unregister(job.id)
-        self.queue.finish(job, state, exit_code=rc, error=error)
+        cascaded = self.queue.finish(job, state, exit_code=rc, error=error)
         self._notify(job, {"event": "done", "job": job.id, "state": state,
                            "exit_code": rc, "error": error,
                            "seconds": job.describe().get("seconds"),
                            "warm_compile_hits": job.warm_compile_hits,
                            "telemetry_dir": job.telemetry_dir})
         job.waiters.clear()   # done delivered; drop follower queues
+        for child in cascaded:
+            # dependents cancelled because THIS job failed: their
+            # followers' streams close here — no slot will ever run them
+            self._notify(child, {"event": "done", "job": child.id,
+                                 "state": child.state, "exit_code": None,
+                                 "error": child.error})
+            child.waiters.clear()
 
 
 def _streaming_forwarder(job: Job):

@@ -19,6 +19,14 @@ through :func:`config.overrides`, never the process environment) and
 their :class:`utils.cancel.CancelToken`; cancelling a QUEUED job is a
 pure state flip, cancelling a RUNNING one sets the token and lets the
 work loops' poison points unwind it.
+
+Jobs may also declare **dependency edges** (``bst submit --after
+<job-id>[,...]``): a job with unmet parents waits OUTSIDE the runnable
+backlog (state QUEUED, ``waiting_on`` listing the open parents) until
+every parent finishes DONE; a parent that fails or is cancelled cancels
+the child — and, transitively, the child's own dependents. This is the
+daemon-side primitive `bst submit --pipeline` chains stages on, and it
+is useful standalone (submit a fusion now, a downsample after it).
 """
 
 from __future__ import annotations
@@ -26,6 +34,7 @@ from __future__ import annotations
 import threading
 import time
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -49,6 +58,11 @@ _WAIT = _metrics.histogram("bst_serve_wait_seconds")
 # response) without bound — oldest finished jobs age out past this
 MAX_FINISHED_JOBS = 200
 
+# terminal STATES remembered past pruning, so `--after <old-job>` keeps
+# its documented semantics (DONE parent -> runnable, FAILED/CANCELLED ->
+# cancel) even after the job itself aged out of the registry
+MAX_PRUNED_STATES = 2000
+
 
 @dataclass
 class Job:
@@ -61,6 +75,7 @@ class Job:
     share: str = "default"
     overrides: dict[str, str] = field(default_factory=dict)
     cost: float = 1.0            # relative placement weight (LPT)
+    after: list[str] = field(default_factory=list)  # parent job ids
     state: str = QUEUED
     submitted_at: float = field(default_factory=time.time)
     started_at: float | None = None
@@ -88,6 +103,8 @@ class Job:
         }
         if self.overrides:
             d["overrides"] = dict(self.overrides)
+        if self.after:
+            d["after"] = list(self.after)
         if self.started_at is not None:
             d["seconds"] = round((self.finished_at or now)
                                  - self.started_at, 3)
@@ -112,7 +129,9 @@ class JobQueue:
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         self._jobs: dict[str, Job] = {}
-        self._order: list[str] = []        # ids still QUEUED, FIFO
+        self._order: list[str] = []        # ids runnable now, FIFO
+        self._waiting: dict[str, set[str]] = {}  # id -> open parent ids
+        self._pruned: OrderedDict[str, str] = OrderedDict()  # id -> state
         self._share_runtime: dict[str, float] = {}
         self._seq = 0
         self._closed = False
@@ -120,17 +139,73 @@ class JobQueue:
     # -- submission / lookup ------------------------------------------------
 
     def submit(self, job: Job) -> Job:
+        """Register + enqueue a job. Jobs with ``after`` parents that are
+        still open wait off the runnable backlog; a parent that already
+        failed/cancelled cancels the job on the spot (state CANCELLED on
+        the returned job). Raises KeyError for an unknown parent id."""
         with self._nonempty:
             if self._closed:
                 raise RuntimeError("daemon is draining: not accepting jobs")
+            unmet: set[str] = set()
+            doomed_by = None
+            for p in job.after:
+                parent = self._jobs.get(p)
+                state = parent.state if parent is not None \
+                    else self._pruned.get(p)
+                if state is None:
+                    raise KeyError(f"unknown job {p!r} in --after")
+                if state in (FAILED, CANCELLED):
+                    doomed_by = (p, state)
+                elif state != DONE:
+                    unmet.add(p)
             self._seq += 1
             job.seq = self._seq
             self._jobs[job.id] = job
-            self._order.append(job.id)
             _SUBMITTED.inc()
-            _DEPTH.set(len(self._order))
+            if doomed_by is not None:
+                self._cancel_locked(job, f"parent {doomed_by[0]} "
+                                         f"{doomed_by[1]}")
+            elif unmet:
+                self._waiting[job.id] = unmet
+            else:
+                self._order.append(job.id)
+            self._update_depth_locked()
             self._nonempty.notify_all()
         return job
+
+    def _update_depth_locked(self) -> None:
+        _DEPTH.set(len(self._order) + len(self._waiting))
+
+    def _cancel_locked(self, job: Job, error: str | None = None) -> None:
+        """Flip a not-yet-started job to terminal CANCELLED and cascade
+        to its waiting dependents."""
+        if job.state in (DONE, FAILED, CANCELLED):
+            return  # diamond dependency: already cancelled via a sibling
+        job.token.cancel()
+        job.state = CANCELLED
+        job.error = error
+        job.finished_at = time.time()
+        self._waiting.pop(job.id, None)
+        if job.id in self._order:
+            self._order.remove(job.id)
+        _metrics.counter("bst_serve_jobs_completed_total",
+                         status=CANCELLED).inc()
+        self._resolve_children_locked(job)
+
+    def _resolve_children_locked(self, job: Job) -> None:
+        """A job reached a terminal state: release children waiting on it
+        (DONE) or cancel them — and their cones — (FAILED/CANCELLED)."""
+        children = [self._jobs[c] for c, open_ids in list(self._waiting.items())
+                    if job.id in open_ids]
+        for child in children:
+            if job.state == DONE:
+                open_ids = self._waiting[child.id]
+                open_ids.discard(job.id)
+                if not open_ids:
+                    del self._waiting[child.id]
+                    self._order.append(child.id)
+            else:
+                self._cancel_locked(child, f"parent {job.id} {job.state}")
 
     def get(self, job_id: str) -> Job | None:
         with self._lock:
@@ -142,7 +217,14 @@ class JobQueue:
 
     def depth(self) -> int:
         with self._lock:
-            return len(self._order)
+            return len(self._order) + len(self._waiting)
+
+    def waiting_on(self, job_id: str) -> set[str] | None:
+        """Open parent ids a queued job still waits for (None when it is
+        runnable / unknown)."""
+        with self._lock:
+            open_ids = self._waiting.get(job_id)
+            return set(open_ids) if open_ids is not None else None
 
     def active(self) -> int:
         with self._lock:
@@ -150,8 +232,8 @@ class JobQueue:
 
     def idle(self) -> bool:
         with self._lock:
-            return not self._order and not any(
-                j.state == RUNNING for j in self._jobs.values())
+            return (not self._order and not self._waiting and not any(
+                j.state == RUNNING for j in self._jobs.values()))
 
     # -- scheduling ---------------------------------------------------------
 
@@ -193,7 +275,7 @@ class JobQueue:
                     job.state = RUNNING
                     job.slot = slot_id
                     job.started_at = time.time()
-                    _DEPTH.set(len(self._order))
+                    self._update_depth_locked()
                     _ACTIVE.inc(1)
                     _WAIT.observe(job.started_at - job.submitted_at)
                     return job
@@ -208,7 +290,11 @@ class JobQueue:
                     self._nonempty.wait()
 
     def finish(self, job: Job, state: str, exit_code: int | None = None,
-               error: str | None = None) -> None:
+               error: str | None = None) -> list[Job]:
+        """Record a job's terminal state; resolves dependency edges (DONE
+        releases waiting children, FAILED/CANCELLED cancels their cones).
+        Returns the children cancelled by cascade so the daemon can close
+        their followers' streams."""
         with self._nonempty:
             job.state = state
             job.exit_code = exit_code
@@ -221,41 +307,53 @@ class JobQueue:
                 _ACTIVE.inc(-1)
             _metrics.counter("bst_serve_jobs_completed_total",
                              status=state).inc()
+            before = {j.id for j in self._jobs.values()
+                      if j.state == CANCELLED}
+            self._resolve_children_locked(job)
+            cascaded = [j for j in self._jobs.values()
+                        if j.state == CANCELLED and j.id not in before]
+            self._update_depth_locked()
             self._prune_locked()
             self._nonempty.notify_all()
+        return cascaded
 
     def _prune_locked(self) -> None:
         terminal = [i for i, j in self._jobs.items()
                     if j.state in (DONE, FAILED, CANCELLED)]
         for jid in terminal[:max(0, len(terminal) - MAX_FINISHED_JOBS)]:
+            # remember the terminal state (bounded) so --after edges to
+            # pruned jobs keep their semantics instead of erroring
+            self._pruned[jid] = self._jobs[jid].state
             del self._jobs[jid]   # dict order == submission order
+        while len(self._pruned) > MAX_PRUNED_STATES:
+            self._pruned.popitem(last=False)
 
     def cancel(self, job_id: str) -> Job | None:
-        """Cancel a job: queued -> terminal CANCELLED immediately; running
-        -> set its token (the work loops unwind at their poison points).
-        Returns the job, or None when unknown."""
+        """Cancel a job: queued/waiting -> terminal CANCELLED immediately
+        (dependents cancel by cascade); running -> set its token (the
+        work loops unwind at their poison points). Returns the job, or
+        None when unknown."""
         with self._nonempty:
             job = self._jobs.get(job_id)
             if job is None:
                 return None
             job.token.cancel()
             if job.state == QUEUED:
-                self._order.remove(job_id)
-                job.state = CANCELLED
-                job.finished_at = time.time()
-                _DEPTH.set(len(self._order))
-                _metrics.counter("bst_serve_jobs_completed_total",
-                                 status=CANCELLED).inc()
+                self._cancel_locked(job)
+                self._update_depth_locked()
                 self._nonempty.notify_all()
             return job
 
     def close(self) -> list[Job]:
-        """Stop accepting; cancel everything still QUEUED (drain keeps the
-        RUNNING jobs). Returns the jobs cancelled off the queue."""
+        """Stop accepting; cancel everything still QUEUED — runnable and
+        dependency-waiting alike (drain keeps the RUNNING jobs). Returns
+        the jobs cancelled off the queue."""
         with self._nonempty:
             self._closed = True
-            doomed = [self._jobs[i] for i in self._order]
+            doomed = [self._jobs[i] for i in
+                      [*self._order, *self._waiting]]
             self._order.clear()
+            self._waiting.clear()
             for job in doomed:
                 job.token.cancel()
                 job.state = CANCELLED

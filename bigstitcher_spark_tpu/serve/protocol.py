@@ -11,7 +11,7 @@ Requests::
 
     {"op": "submit", "tool": "...", "args": [...], "priority": 0,
      "share": "...", "overrides": {"BST_X": "..."}, "cost": 1.0,
-     "follow": true}
+     "follow": true, "after": ["j0001"]}
     {"op": "jobs"}            {"op": "cancel", "job": "..."}
     {"op": "shutdown", "drain": true}        {"op": "ping"}
 """

@@ -1,0 +1,406 @@
+"""The `bst pipeline` streaming stage-DAG executor: spec validation, the
+block-exchange registry (gating, handoff, release-on-finish), the
+failure-cone + ephemeral-container lifecycle, and the tier-1 acceptance
+E2E — a streamed resave->fuse->downsample->detect pipeline bit-identical
+to the staged one-shot CLI sequence with ZERO container re-reads of the
+elided intermediate (counted by the bst_dag_* metrics)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from bigstitcher_spark_tpu.cli.main import cli
+from bigstitcher_spark_tpu.dag import (
+    PipelineSpec,
+    SpecError,
+    example_spec,
+    run_pipeline,
+)
+from bigstitcher_spark_tpu.dag import stream
+from bigstitcher_spark_tpu.io.chunkstore import (
+    ChunkStore,
+    StorageFormat,
+    _DAG_HOOKS,
+)
+from bigstitcher_spark_tpu.observe import metrics
+
+
+def _mk_project(tmp_path, name="proj", **kw):
+    from bigstitcher_spark_tpu.utils.testdata import make_synthetic_project
+
+    spec = dict(n_tiles=(2, 1, 1), tile_size=(64, 64, 32), overlap=16,
+                jitter=1.0, n_beads_per_tile=20, seed=7)
+    spec.update(kw)
+    return make_synthetic_project(str(tmp_path / name), **spec).xml_path
+
+
+def _small_blocks(spec):
+    """Shrink the example spec's containers to 32^2 x 16 blocks so the
+    tiny fixtures stream tens of blocks instead of one."""
+    for s in spec["stages"]:
+        if s["id"] == "resave":
+            s["args"] += ["--blockSize", "32,32,16", "-ds", "1,1,1; 2,2,1"]
+        if s["id"] == "create":
+            s["args"] += ["--blockSize", "32,32,16"]
+    return spec
+
+
+# -- spec validation ---------------------------------------------------------
+
+
+class TestSpec:
+    def test_unknown_tool_rejected(self):
+        with pytest.raises(SpecError, match="unservable"):
+            PipelineSpec.from_dict(
+                {"stages": [{"id": "a", "tool": "no-such-tool"}]})
+        with pytest.raises(SpecError, match="unservable"):
+            PipelineSpec.from_dict(
+                {"stages": [{"id": "a", "tool": "pipeline"}]})
+
+    def test_cycle_rejected(self):
+        with pytest.raises(SpecError, match="cycle"):
+            PipelineSpec.from_dict({"stages": [
+                {"id": "a", "tool": "config", "after": ["b"]},
+                {"id": "b", "tool": "config", "after": ["a"]}]})
+
+    def test_stream_edges_participate_in_cycle_check(self):
+        with pytest.raises(SpecError, match="cycle"):
+            PipelineSpec.from_dict({
+                "datasets": {"x": {}, "y": {}},
+                "stages": [
+                    {"id": "a", "tool": "config", "writes": ["x"],
+                     "reads": ["y"]},
+                    {"id": "b", "tool": "config", "writes": ["y"],
+                     "reads": ["x"]}]})
+
+    def test_undeclared_refs_rejected(self):
+        with pytest.raises(SpecError, match="undeclared dataset"):
+            PipelineSpec.from_dict({"stages": [
+                {"id": "a", "tool": "config", "reads": ["ghost"]}]})
+        with pytest.raises(SpecError, match="undeclared dataset"):
+            PipelineSpec.from_dict({"stages": [
+                {"id": "a", "tool": "config", "args": ["-o", "@ghost"]}]})
+        with pytest.raises(SpecError, match="unknown stage"):
+            PipelineSpec.from_dict({"stages": [
+                {"id": "a", "tool": "config", "after": ["ghost"]}]})
+
+    def test_dataset_needs_a_producer(self):
+        with pytest.raises(SpecError, match="no producer"):
+            PipelineSpec.from_dict({
+                "datasets": {"x": {}},
+                "stages": [{"id": "a", "tool": "config", "reads": ["x"]}]})
+
+    def test_duplicate_stage_ids_rejected(self):
+        with pytest.raises(SpecError, match="duplicate"):
+            PipelineSpec.from_dict({"stages": [
+                {"id": "a", "tool": "config"},
+                {"id": "a", "tool": "config"}]})
+
+    def test_resolution_and_substitution(self, tmp_path):
+        spec = PipelineSpec.from_dict({
+            "datasets": {"eph": {"ephemeral": True},
+                         "kept": {"path": "out.n5"}},
+            "stages": [{"id": "a", "tool": "config",
+                        "args": ["-o", "@eph", "-k", "@kept",
+                                 "-w", "@workdir/x"],
+                        "writes": ["eph", "kept"]}]})
+        spec.resolve(str(tmp_path), keep_intermediates=False, run_id="r1")
+        args = spec.stages[0].args
+        assert args[1].startswith("memory://bst-dag-r1/")
+        assert args[3] == str(tmp_path / "out.n5")
+        assert args[5] == str(tmp_path / "x")
+        assert spec.datasets["eph"].elided
+        # keep-intermediates materializes at the declared path instead
+        spec2 = PipelineSpec.from_dict({
+            "datasets": {"eph": {"ephemeral": True, "path": "mid.n5"}},
+            "stages": [{"id": "a", "tool": "config", "args": ["@eph"],
+                        "writes": ["eph"]}]})
+        spec2.resolve(str(tmp_path), keep_intermediates=True, run_id="r2")
+        assert spec2.stages[0].args[0] == str(tmp_path / "mid.n5")
+        assert not spec2.datasets["eph"].elided
+
+    def test_example_spec_validates(self, tmp_path):
+        d = example_spec(str(tmp_path / "dataset.xml"))
+        spec = PipelineSpec.from_dict(d)
+        assert {s.id for s in spec.stages} == \
+            {"resave", "create", "fuse", "downsample", "detect"}
+        # downsample streams from fuse; detect barriers on resave's XML
+        fuse = next(s for s in spec.stages if s.id == "downsample")
+        assert spec.stream_parents(fuse) == {"fuse"}
+        detect = next(s for s in spec.stages if s.id == "detect")
+        assert "resave" in spec.barrier_parents(detect)
+        assert spec.stream_parents(detect) == {"resave"}
+
+
+# -- the block-exchange registry --------------------------------------------
+
+
+class TestStreamRegistry:
+    def _edge_env(self, tmp_path):
+        store = ChunkStore.create(str(tmp_path / "edge.n5"),
+                                  StorageFormat.N5)
+        ds = store.create_dataset("s0", (64, 32, 16), (16, 16, 16),
+                                  "uint16")
+        prod = stream.StageToken("prod", "t")
+        cons = stream.StageToken("cons", "t")
+        edge = stream.EdgeState("e", store.root, {prod}, {cons})
+        reg = stream.registry()
+        reg.register([edge])
+        return reg, store, ds, prod, cons, edge
+
+    def test_gate_blocks_until_publish_and_serves_from_handoff(
+            self, tmp_path):
+        reg, store, ds, prod, cons, edge = self._edge_env(tmp_path)
+        got = {}
+        try:
+            def consume():
+                with stream.stage_scope(cons):
+                    got["data"] = ds.read((0, 0, 0), (32, 32, 16))
+
+            th = threading.Thread(target=consume)
+            th.start()
+            time.sleep(0.3)
+            assert th.is_alive(), "consumer must block on unwritten blocks"
+            data = (np.arange(64 * 32 * 16, dtype=np.uint16)
+                    .reshape(64, 32, 16))
+            with stream.stage_scope(prod):
+                ds.write(data, (0, 0, 0))
+            th.join(timeout=20)
+            assert not th.is_alive()
+            assert np.array_equal(got["data"], data[:32])
+            assert edge.blocks_published == 8     # 4x2x1 chunk grid
+            assert edge.bytes_elided > 0          # served by the handoff
+            assert edge.bytes_reread == 0
+        finally:
+            reg.unregister([edge])
+        assert _DAG_HOOKS[0] is None              # last edge uninstalled
+
+    def test_gate_releases_when_producers_finish(self, tmp_path):
+        reg, store, ds, prod, cons, edge = self._edge_env(tmp_path)
+        try:
+            done = threading.Event()
+
+            def consume():
+                with stream.stage_scope(cons):
+                    ds.read((48, 0, 0), (16, 16, 16))  # never written
+                done.set()
+
+            th = threading.Thread(target=consume)
+            th.start()
+            time.sleep(0.3)
+            assert not done.is_set()
+            reg.stage_finished(prod)   # fusion's "empty block" case
+            th.join(timeout=20)
+            assert done.is_set()
+        finally:
+            reg.unregister([edge])
+
+    def test_producer_reads_pass_ungated(self, tmp_path):
+        reg, store, ds, prod, cons, edge = self._edge_env(tmp_path)
+        try:
+            with stream.stage_scope(prod):
+                out = ds.read((0, 0, 0), (16, 16, 16))  # no deadlock
+            assert out.shape == (16, 16, 16)
+        finally:
+            reg.unregister([edge])
+
+    def test_consumer_release_frees_exchange(self, tmp_path):
+        reg, store, ds, prod, cons, edge = self._edge_env(tmp_path)
+        try:
+            data = np.ones((64, 32, 16), np.uint16)
+            with stream.stage_scope(prod):
+                ds.write(data, (0, 0, 0))
+            assert metrics.gauge("bst_dag_exchange_bytes").value > 0
+            reg.stage_finished(cons)   # consumer ends without reading all
+            assert metrics.gauge("bst_dag_exchange_bytes").value == 0
+        finally:
+            reg.unregister([edge])
+
+
+# -- executor: failure cone + ephemeral lifecycle ----------------------------
+
+
+class TestExecutor:
+    def test_failure_cancels_cone_independent_branch_finishes(
+            self, tmp_path):
+        res = run_pipeline({
+            "name": "cone",
+            "datasets": {"x": {"ephemeral": True, "stream": False}},
+            "stages": [
+                {"id": "solo", "tool": "config", "args": []},
+                {"id": "bad", "tool": "downsample",
+                 "args": ["-i", str(tmp_path / "missing.n5"),
+                          "-di", "s0", "-ds", "2,2,1"],
+                 "writes": ["x"]},
+                {"id": "child", "tool": "config", "args": [],
+                 "reads": ["x"]},
+                {"id": "grandchild", "tool": "config", "args": [],
+                 "after": ["child"]},
+            ]}, workdir=str(tmp_path))
+        states = {r["id"]: r["state"] for r in res.stages}
+        assert not res.ok
+        assert states == {"solo": "done", "bad": "failed",
+                          "child": "cancelled", "grandchild": "cancelled"}
+        assert _DAG_HOOKS[0] is None   # hooks uninstalled even on failure
+
+    def test_ephemeral_cleaned_on_success_and_failure(self, tmp_path):
+        xml = _mk_project(tmp_path)
+        proj = os.path.dirname(xml)
+        # disk-backed ephemeral + a failing consumer: the half-written
+        # tree must not survive the run
+        res = run_pipeline({
+            "name": "cleanup",
+            "datasets": {"resaved": {"ephemeral": True,
+                                     "backing": "disk"}},
+            "stages": [
+                {"id": "resave", "tool": "resave",
+                 "args": ["-x", xml, "-xo",
+                          os.path.join(proj, "re.xml"),
+                          "-o", "@resaved", "--N5",
+                          "-ds", "1,1,1"],
+                 "writes": ["resaved"]},
+                {"id": "bad", "tool": "downsample",
+                 "args": ["-i", str(tmp_path / "missing.n5"),
+                          "-di", "s0", "-ds", "2,2,1"],
+                 "after": ["resave"], "reads": ["resaved"],
+                 "writes": ["resaved"]},
+            ]}, workdir=str(tmp_path))
+        assert not res.ok
+        leftovers = [d for d in os.listdir(tmp_path)
+                     if d.startswith(".bst-dag-tmp-")]
+        assert leftovers == [], leftovers
+
+    def test_keep_intermediates_materializes_on_disk(self, tmp_path):
+        xml = _mk_project(tmp_path)
+        proj = os.path.dirname(xml)
+        res = run_pipeline({
+            "name": "keep",
+            "datasets": {"resaved": {
+                "ephemeral": True,
+                "path": os.path.join(proj, "kept-resaved.n5")}},
+            "stages": [
+                {"id": "resave", "tool": "resave",
+                 "args": ["-x", xml, "-xo",
+                          os.path.join(proj, "kept.xml"),
+                          "-o", "@resaved", "--N5", "-ds", "1,1,1"],
+                 "writes": ["resaved"]},
+            ]}, workdir=str(tmp_path), keep_intermediates=True)
+        assert res.ok, res.to_dict()
+        assert res.containers_elided == 0
+        kept = os.path.join(proj, "kept-resaved.n5")
+        assert res.kept_intermediates == [kept]
+        assert ChunkStore.open(kept).is_dataset("setup0/timepoint0/s0")
+
+
+# -- acceptance E2E ----------------------------------------------------------
+
+
+class TestStreamedParity:
+    def _staged(self, runner, xml):
+        proj = os.path.dirname(xml)
+        rexml = os.path.join(proj, "pipeline-resaved.xml")
+        cmds = [
+            ["resave", "-x", xml, "-xo", rexml,
+             "-o", f"{proj}/pipeline-resaved.n5", "--N5",
+             "--blockSize", "32,32,16", "-ds", "1,1,1; 2,2,1"],
+            ["create-fusion-container", "-x", rexml,
+             "-o", f"{proj}/pipeline-fused.n5", "-s", "N5", "-d", "UINT16",
+             "--minIntensity", "0", "--maxIntensity", "65535",
+             "--blockSize", "32,32,16"],
+            ["affine-fusion", "-o", f"{proj}/pipeline-fused.n5"],
+            ["downsample", "-i", f"{proj}/pipeline-fused.n5",
+             "-di", "ch0tp0/s0", "-ds", "2,2,1"],
+            ["detect-interestpoints", "-x", rexml, "-l", "beads",
+             "-s", "1.8", "-t", "0.008", "-dsxy", "1", "-dsz", "1"],
+        ]
+        for args in cmds:
+            r = runner.invoke(cli, args, catch_exceptions=False)
+            assert r.exit_code == 0, f"bst {' '.join(args)}\n{r.output}"
+
+    def test_streamed_pipeline_bit_identical_and_zero_rereads(
+            self, tmp_path):
+        """Acceptance: the streamed resave->fuse->downsample->detect
+        pipeline produces bit-identical fused volumes, pyramid levels and
+        interest points vs the staged one-shot CLI sequence, the resaved
+        intermediate is elided to memory and its consumers re-read ZERO
+        container bytes (bst_dag_* counted), and the elided container is
+        cleaned up."""
+        xml = _mk_project(tmp_path, "streamed")
+        proj = os.path.dirname(xml)
+        spec = _small_blocks(example_spec(xml))
+        reread = metrics.counter("bst_dag_bytes_reread_total")
+        elided_ctr = metrics.counter("bst_dag_containers_elided_total")
+        r0, c0 = reread.value, elided_ctr.value
+        res = run_pipeline(spec, workdir=str(tmp_path))
+        assert res.ok, res.to_dict()
+        summary = res.to_dict()
+        # zero container reads of ANY streamed edge this run...
+        assert reread.value - r0 == 0
+        # ...and per-edge: the elided intermediate specifically
+        by_edge = {e["edge"]: e for e in summary["edges"]}
+        assert by_edge["resaved"]["elided"]
+        assert by_edge["resaved"]["bytes_reread"] == 0
+        assert by_edge["resaved"]["bytes_elided"] > 0
+        assert by_edge["resaved"]["blocks_streamed"] > 0
+        assert by_edge["fused"]["blocks_streamed"] > 0
+        assert elided_ctr.value - c0 == 1
+        # the elided container never touched disk and is gone from memory
+        assert not os.path.exists(os.path.join(proj, "pipeline-resaved.n5"))
+        eph_root = by_edge["resaved"]["root"]
+        assert eph_root.startswith("memory://")
+        assert not ChunkStore(eph_root, StorageFormat.N5).exists(
+            "setup0/timepoint0/s0")
+
+        # staged one-shot sequence on an identical project (same seed)
+        xml_d = _mk_project(tmp_path, "staged")
+        proj_d = os.path.dirname(xml_d)
+        self._staged(CliRunner(), xml_d)
+
+        for name in ("ch0tp0/s0", "ch0tp0/s1"):
+            a = ChunkStore.open(
+                f"{proj}/pipeline-fused.n5").open_dataset(name).read_full()
+            b = ChunkStore.open(
+                f"{proj_d}/pipeline-fused.n5").open_dataset(name).read_full()
+            assert np.array_equal(a, b), name
+
+        from bigstitcher_spark_tpu.io.interestpoints import \
+            InterestPointStore
+        from bigstitcher_spark_tpu.io.spimdata import SpimData
+
+        sa = SpimData.load(os.path.join(proj, "pipeline-resaved.xml"))
+        sb = SpimData.load(os.path.join(proj_d, "pipeline-resaved.xml"))
+        ia, ib = (InterestPointStore.for_project(sa),
+                  InterestPointStore.for_project(sb))
+        for v in sa.view_ids():
+            pa, _ = ia.load_points(v, "beads")
+            pb, _ = ib.load_points(v, "beads")
+            assert len(pa) and np.array_equal(pa, pb)
+
+    def test_pipeline_run_cli(self, tmp_path):
+        """`bst pipeline init` + `bst pipeline run --summary` round trip
+        (the CLI face of the executor; the heavy parity is above)."""
+        xml = _mk_project(tmp_path)
+        runner = CliRunner()
+        spec_path = str(tmp_path / "p.json")
+        r = runner.invoke(cli, ["pipeline", "init", spec_path, "-x", xml],
+                          catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        spec = json.load(open(spec_path))
+        json.dump(_small_blocks(spec), open(spec_path, "w"))
+        summary_path = str(tmp_path / "summary.json")
+        r = runner.invoke(cli, ["pipeline", "run", "--summary",
+                                summary_path, spec_path],
+                          catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        summary = json.load(open(summary_path))
+        assert summary["ok"] and summary["containers_elided"] == 1
+        assert summary["bytes_reread"] == 0
+        # dry-run prints the plan without executing
+        r = runner.invoke(cli, ["pipeline", "run", "--dryRun", spec_path],
+                          catch_exceptions=False)
+        assert r.exit_code == 0 and "streams-from=fuse" in r.output

@@ -32,7 +32,8 @@ def test_installer_covers_every_cli_tool(installed_bin):
     renamed = {"env": "bst-env", "lint": "bst-lint", "config": "bst-config",
                "trace-report": "bst-trace-report",
                "serve": "bst-serve", "submit": "bst-submit",
-               "jobs": "bst-jobs", "cancel": "bst-cancel"}
+               "jobs": "bst-jobs", "cancel": "bst-cancel",
+               "pipeline": "bst-pipeline"}
     expected = {renamed.get(t, t) for t in set(cli.commands)}
     missing = expected - wrappers
     assert not missing, f"installer missing wrappers for: {sorted(missing)}"
@@ -56,3 +57,9 @@ def test_serve_wrappers(installed_bin):
         w = installed_bin / name
         assert os.access(w, os.X_OK), name
         assert re.search(rf"cli\.main {tool}", w.read_text()), name
+
+
+def test_pipeline_wrapper(installed_bin):
+    w = installed_bin / "bst-pipeline"
+    assert os.access(w, os.X_OK)
+    assert re.search(r"cli\.main pipeline", w.read_text())

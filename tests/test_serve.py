@@ -115,6 +115,58 @@ class TestJobQueue:
         with pytest.raises(RuntimeError):
             q.submit(self._job("b"))
 
+    def test_after_waits_for_parent_then_releases(self):
+        q = JobQueue(slots=1)
+        q.submit(self._job("parent"))
+        child = self._job("child", after=["parent"])
+        q.submit(child)
+        assert q.waiting_on("child") == {"parent"}
+        assert q.depth() == 2
+        taken = q.take(0, timeout=1)
+        assert taken.id == "parent"
+        # child must not be runnable while the parent is still open
+        assert q.take(0, timeout=0.1) is None
+        q.finish(taken, "done", exit_code=0)
+        assert q.waiting_on("child") is None
+        assert q.take(0, timeout=1).id == "child"
+
+    def test_after_parent_failure_cancels_cascade(self):
+        q = JobQueue(slots=1)
+        q.submit(self._job("parent"))
+        q.submit(self._job("child", after=["parent"]))
+        q.submit(self._job("grandchild", after=["child"]))
+        taken = q.take(0, timeout=1)
+        cascaded = q.finish(taken, "failed", exit_code=1)
+        assert {j.id for j in cascaded} == {"child", "grandchild"}
+        states = {j.id: j.state for j in q.jobs()}
+        assert states["child"] == "cancelled"
+        assert states["grandchild"] == "cancelled"
+        assert q.depth() == 0
+
+    def test_after_terminal_parent_at_submit(self):
+        q = JobQueue(slots=1)
+        q.submit(self._job("ok"))
+        q.finish(q.take(0, timeout=1), "done", exit_code=0)
+        # DONE parent: runnable immediately
+        q.submit(self._job("a", after=["ok"]))
+        assert q.take(0, timeout=1).id == "a"
+        q.finish(q.get("a"), "failed", exit_code=1)
+        # FAILED parent: cancelled on the spot
+        doomed = self._job("b", after=["a"])
+        q.submit(doomed)
+        assert doomed.state == "cancelled"
+        with pytest.raises(KeyError, match="unknown job"):
+            q.submit(self._job("c", after=["no-such-job"]))
+
+    def test_cancel_waiting_job_and_close_cancels_waiting(self):
+        q = JobQueue(slots=2)
+        q.submit(self._job("p1"))
+        q.submit(self._job("w1", after=["p1"]))
+        assert q.cancel("w1").state == "cancelled"
+        q.submit(self._job("w2", after=["p1"]))
+        doomed = q.close()
+        assert {j.id for j in doomed} == {"p1", "w2"}
+
     def test_finished_history_is_bounded(self):
         from bigstitcher_spark_tpu.serve.jobs import MAX_FINISHED_JOBS
 
@@ -368,6 +420,49 @@ class TestDaemonE2E:
         listing = client.list_jobs(sock)
         states = {j["id"]: j["state"] for j in listing["jobs"]}
         assert set(states.values()) == {"failed", "done"}
+
+    def test_submit_after_chains_and_cancels_on_failure(self, tmp_path,
+                                                        daemon):
+        """The `bst submit --after` dependency edges: a child waits for
+        its parent's success and starts only afterwards; a child of a
+        failing parent is cancelled without ever running."""
+        sock = daemon.socket_path
+        acc = client.submit(sock, "config", [], follow=False)
+        child = client.submit(sock, "config", [], after=[acc["job"]])
+        assert child["state"] == "done" and child["exit_code"] == 0
+        # parent that fails -> dependent cancelled, never runs
+        bad = client.submit(sock, "affine-fusion",
+                            ["-o", str(tmp_path / "nope.zarr")],
+                            follow=False)
+        doomed = client.submit(sock, "config", [], after=[bad["job"]])
+        assert doomed["state"] == "cancelled"
+        assert doomed.get("exit_code") is None
+        states = {j["id"]: j for j in client.list_jobs(sock)["jobs"]}
+        assert states[bad["job"]]["state"] == "failed"
+        # unknown parent is a protocol error
+        with pytest.raises(RuntimeError, match="unknown job"):
+            client.submit(sock, "config", [], after=["zzz"])
+
+    def test_pipeline_through_daemon(self, tmp_path, daemon):
+        """`bst submit --pipeline`: a whole spec runs as one daemon job
+        (stages chain in-process on the daemon's warm caches)."""
+        sock = daemon.socket_path
+        spec = {"name": "served", "stages": [
+            {"id": "a", "tool": "config", "args": []},
+            {"id": "b", "tool": "config", "args": [], "after": ["a"]}]}
+        spec_path = tmp_path / "spec.json"
+        spec_path.write_text(json.dumps(spec))
+        res = client.submit(sock, "pipeline", ["run", str(spec_path)])
+        assert res["state"] == "done" and res["exit_code"] == 0, res
+        out = open(os.path.join(res["telemetry_dir"],
+                                "output.log")).read()
+        assert "pipeline served:" in out
+        # the CLI spelling: bst submit --pipeline <spec>
+        runner = CliRunner()
+        r = runner.invoke(cli, ["submit", "--socket", sock, "--quiet",
+                                "--pipeline", str(spec_path)],
+                          catch_exceptions=False)
+        assert r.exit_code == 0, r.output
 
     def test_jobs_and_cancel_cli_commands(self, tmp_path, daemon):
         runner = CliRunner()
