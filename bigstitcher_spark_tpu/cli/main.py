@@ -14,6 +14,7 @@ from . import (
     detection_tools,
     fusion_tools,
     intensity_tools,
+    observe_tools,
     pipeline_tools,
     resave_tools,
     serve_tools,
@@ -24,14 +25,32 @@ from . import (
 )
 
 
+# tools that must NOT auto-bind the BST_METRICS_PORT exporter: daemon
+# management and thin clients run on the same host as the daemon that
+# owns the port (the `bst serve --detach` parent or a `bst submit` would
+# steal it for milliseconds and break the resident daemon's bind), and
+# the short diagnostic tools have nothing live to export. The daemon
+# itself starts its exporter inside Daemon.start().
+_NO_LIVE_EXPORTER = {"serve", "submit", "jobs", "cancel", "top",
+                     "trace-dump", "history", "perf-diff", "config",
+                     "env", "lint", "telemetry-merge", "trace-report"}
+
+
 @click.group()
-def cli():
+@click.pass_context
+def cli(ctx):
     """TPU-native BigStitcher: distributed stitching & fusion tools."""
     # multi-host bootstrap: no-op unless BST_COORDINATOR/BST_NUM_PROCESSES/
     # BST_PROCESS_ID (or BST_DISTRIBUTED=1 on an autodetecting pod) are set
     from ..parallel.distributed import init_distributed
 
     init_distributed()
+    # live HTTP exporter for long one-shot runs: no-op unless
+    # BST_METRICS_PORT is set (the serve daemon wires richer providers in)
+    if ctx.invoked_subcommand not in _NO_LIVE_EXPORTER:
+        from ..observe import httpexport
+
+        httpexport.ensure_started()
 
 
 cli.add_command(fusion_tools.create_fusion_container_cmd, "create-fusion-container")
@@ -62,6 +81,10 @@ cli.add_command(serve_tools.submit_cmd, "submit")
 cli.add_command(serve_tools.jobs_cmd, "jobs")
 cli.add_command(serve_tools.cancel_cmd, "cancel")
 cli.add_command(pipeline_tools.pipeline_cmd, "pipeline")
+cli.add_command(observe_tools.top_cmd, "top")
+cli.add_command(observe_tools.trace_dump_cmd, "trace-dump")
+cli.add_command(observe_tools.history_cmd, "history")
+cli.add_command(observe_tools.perf_diff_cmd, "perf-diff")
 
 
 def main():

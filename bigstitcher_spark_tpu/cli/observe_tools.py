@@ -1,0 +1,318 @@
+"""Live-observability CLI: ``bst top`` / ``trace-dump`` / ``history`` /
+``perf-diff``.
+
+``bst top`` is the operator's live terminal view of a resident daemon —
+queue depth, per-share runtime, per-job progress/ETA and stall state,
+cache hit ratios and the in-flight byte high-water — polled over the
+daemon socket (or its HTTP ``/status`` endpoint with ``--url``).
+``bst trace-dump`` snapshots the daemon's always-on flight-recorder ring
+to a Perfetto JSON on demand, without pausing jobs. ``bst history`` and
+``bst perf-diff`` browse and compare the cross-run manifest records the
+``BST_HISTORY_DIR`` store accumulates (observe/history.py) — the
+substrate ``bst tune`` will replay.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import sys
+import time
+
+import click
+
+
+def _socket_opt(f):
+    return click.option("--socket", "socket_path", default=None,
+                        help="daemon Unix socket (default: "
+                             "BST_SERVE_SOCKET or the per-user temp-dir "
+                             "path)")(f)
+
+
+def _history_dir_opt(f):
+    return click.option("--history-dir", "history_dir", default=None,
+                        help="history store directory (default: "
+                             "BST_HISTORY_DIR)")(f)
+
+
+def _fmt_bytes(n) -> str:
+    """telemetry_tools' formatter, tolerant of missing values (a daemon
+    answering mid-warmup may not have every gauge yet)."""
+    from .telemetry_tools import _fmt_bytes as _fmt
+
+    try:
+        return _fmt(float(n))
+    except (TypeError, ValueError):
+        return "?"
+
+
+def _hit_ratio(stats: dict) -> str:
+    h, m = stats.get("hits", 0) or 0, stats.get("misses", 0) or 0
+    return f"{h / (h + m) * 100:.1f}%" if h + m else "-"
+
+
+def _fetch(socket_path, url):
+    """One (status, jobs) sample, over HTTP when --url, else the socket."""
+    if url:
+        import urllib.request
+
+        base = url.rstrip("/")
+        with urllib.request.urlopen(base + "/status", timeout=5) as r:
+            status = _json.load(r)
+        with urllib.request.urlopen(base + "/jobs", timeout=5) as r:
+            jobs = _json.load(r).get("jobs", [])
+        return status, jobs
+    from ..serve import client
+
+    resp = client.list_jobs(socket_path)
+    return resp["daemon"], resp["jobs"]
+
+
+def _render_top(status: dict, jobs: list[dict]) -> str:
+    proc = status.get("process", {})
+    cc = status.get("chunk_cache", {})
+    cf = status.get("compiled_fn", {})
+    infl = status.get("inflight", {})
+    dag = status.get("dag", {})
+    lines = [
+        f"bst serve pid {status.get('pid')}  up {status.get('uptime_s')}s"
+        f"  slots {status.get('slots')}  queued {status.get('queue_depth')}"
+        f"  active {status.get('active')}"
+        f"  stalled {len(status.get('stalled') or [])}",
+        f"process: rss {_fmt_bytes(proc.get('rss_bytes'))}  "
+        f"threads {proc.get('threads', '?')}  "
+        f"fds {proc.get('open_fds', '?')}",
+        f"caches: chunk {_hit_ratio(cc)} hit "
+        f"({cc.get('entries', 0)} entries, {_fmt_bytes(cc.get('bytes', 0))})"
+        f"  compiled-fn warm {cf.get('warm_hits', 0)}"
+        f" / cold {cf.get('cold_builds', 0)}",
+        f"inflight: {_fmt_bytes(infl.get('bytes', 0))} now, "
+        f"{_fmt_bytes(infl.get('highwater_bytes', 0))} high-water"
+        f"  |  dag exchange {_fmt_bytes(dag.get('exchange_bytes', 0))}"
+        f" / {dag.get('exchange_blocks', 0)} blk, "
+        f"stall {round(dag.get('producer_stall_s', 0) or 0, 1)}s "
+        f"wait {round(dag.get('consumer_wait_s', 0) or 0, 1)}s",
+    ]
+    shares = status.get("share_runtime_s") or {}
+    if shares:
+        lines.append("shares: " + "  ".join(
+            f"{k}={v}s" for k, v in sorted(shares.items())))
+    lines.append("")
+    lines.append(f"{'JOB':>6}  {'STATE':<9} {'TOOL':<22} "
+                 f"{'PROGRESS':<22} {'ETA':>6} {'WAIT':>7} {'RUN':>8}")
+    for j in jobs:
+        p = j.get("progress") or {}
+        prog = (f"{p.get('done')}/{p.get('total')} "
+                f"({p.get('rate_per_s')}/s)" if p else "-")
+        eta = f"{p.get('eta_s')}s" if p.get("eta_s") is not None else "-"
+        run = f"{j['seconds']}s" if "seconds" in j else "-"
+        state = j["state"] + ("!" if j.get("stalled") else "")
+        line = (f"{j['id']:>6}  {state:<9} {j['tool']:<22} "
+                f"{prog:<22} {eta:>6} {j['wait_s']:>6}s {run:>8}")
+        if j.get("stalled"):
+            line += f"  STALLED {j.get('stalled_for_s', '?')}s"
+        if j.get("waiting_on"):
+            line += f"  after {','.join(j['waiting_on'])}"
+        lines.append(line)
+    return "\n".join(lines)
+
+
+@click.command()
+@_socket_opt
+@click.option("--url", "url", default=None,
+              help="poll the daemon's HTTP exporter (/status, /jobs) "
+                   "instead of the socket, e.g. http://127.0.0.1:9100")
+@click.option("--interval", type=float, default=2.0, show_default=True,
+              help="refresh period in seconds")
+@click.option("--once", is_flag=True, default=False,
+              help="render a single frame and exit (scripts, tests)")
+def top_cmd(socket_path, url, interval, once):
+    """Live terminal view of a `bst serve` daemon.
+
+    Shows queue depth and per-share runtime, each job's progress/ETA and
+    stall state, cache hit ratios, and the in-flight byte high-water —
+    refreshed every --interval seconds until Ctrl-C."""
+    try:
+        status, jobs = _fetch(socket_path, url)
+    except (OSError, RuntimeError, ValueError) as e:
+        raise click.ClickException(
+            f"{e} — is a daemon running? start one with `bst serve`")
+    if once:
+        click.echo(_render_top(status, jobs))
+        return
+    try:
+        while True:
+            click.echo("\x1b[2J\x1b[H", nl=False)   # clear + home
+            click.echo(_render_top(status, jobs))
+            click.echo(f"\n[{time.strftime('%H:%M:%S')}] refresh every "
+                       f"{interval}s — Ctrl-C to exit")
+            time.sleep(max(0.2, interval))
+            status, jobs = _fetch(socket_path, url)
+    except KeyboardInterrupt:
+        pass
+    except (OSError, RuntimeError, ValueError) as e:
+        raise click.ClickException(f"daemon went away: {e}")
+
+
+@click.command()
+@_socket_opt
+@click.option("--out", "out", default=None,
+              help="output path for the Perfetto JSON (default: "
+                   "trace-dump-<n>.json in the daemon's jobs root)")
+def trace_dump_cmd(socket_path, out):
+    """Snapshot the daemon's live flight-recorder ring to Perfetto JSON.
+
+    The daemon records its timeline always (bounded ring, newest events
+    win); this dumps the current contents WITHOUT pausing jobs or
+    stopping the recorder — load the file in ui.perfetto.dev or run
+    `bst trace-report` on it."""
+    import os
+
+    from ..serve import client
+
+    try:
+        resp = client.trace_dump(socket_path,
+                                 out=os.path.abspath(out) if out else None)
+    except (OSError, RuntimeError) as e:
+        raise click.ClickException(
+            f"{e} — is a daemon running? start one with `bst serve`")
+    click.echo(f"{resp.get('path')} ({resp.get('buffered')} events "
+               f"buffered, {resp.get('dropped')} dropped; analyze with "
+               f"'bst trace-report')")
+
+
+@click.group(invoke_without_command=True)
+@click.pass_context
+def history_cmd(ctx):
+    """Browse the cross-run manifest history store (BST_HISTORY_DIR)."""
+    if ctx.invoked_subcommand is None:
+        ctx.invoke(history_list_cmd)
+
+
+@history_cmd.command("list")
+@_history_dir_opt
+def history_list_cmd(history_dir):
+    """List recorded runs/jobs, oldest first."""
+    from ..observe import history
+
+    try:
+        entries = history.list_records(history_dir)
+    except FileNotFoundError as e:
+        raise click.ClickException(str(e))
+    if not entries:
+        click.echo("history is empty (runs record when BST_HISTORY_DIR "
+                   "is set; import manifests with `bst history add`)")
+        return
+    for e in entries:
+        line = (f"{e.get('ts', '?'):<20} {e.get('status', '?'):<9} "
+                f"{e.get('seconds', '?'):>9}s  {e['id']}")
+        if e.get("job"):
+            line += f"  (job {e['job']})"
+        click.echo(line)
+
+
+@history_cmd.command("show")
+@_history_dir_opt
+@click.argument("record_id")
+def history_show_cmd(history_dir, record_id):
+    """Print one record (by id, unique prefix, or -1 for the latest)."""
+    from ..observe import history
+
+    try:
+        rec = history.load_record(record_id, history_dir)
+    except (FileNotFoundError, KeyError) as e:
+        raise click.ClickException(str(e))
+    click.echo(_json.dumps(rec, indent=1, default=str))
+
+
+@history_cmd.command("add")
+@_history_dir_opt
+@click.argument("path", type=click.Path(exists=True))
+def history_add_cmd(history_dir, path):
+    """Import manifest(s) — a manifest JSON file or a telemetry
+    directory — into the history store."""
+    from ..observe import history
+
+    if history.history_dir(history_dir) is None:
+        raise click.ClickException(
+            "no history dir: set BST_HISTORY_DIR or pass --history-dir")
+    try:
+        ids = history.import_path(path, history_dir)
+    except (FileNotFoundError, ValueError) as e:
+        raise click.ClickException(str(e))
+    for rid in ids:
+        click.echo(rid)
+
+
+@click.command()
+@_history_dir_opt
+@click.option("--threshold", type=float, default=20.0, show_default=True,
+              help="regression threshold in percent (span/byte growth, "
+                   "hit-ratio drop in percentage points)")
+@click.option("--last", "last_n", type=int, default=None,
+              help="diff the N-th most recent record against the most "
+                   "recent (--last 2 = previous vs latest; RUN_A/RUN_B "
+                   "are then optional)")
+@click.option("--json", "as_json", is_flag=True,
+              help="machine-readable diff")
+@click.option("--fail-on-regression", is_flag=True, default=False,
+              help="exit 2 when any regression is flagged (CI gate)")
+@click.argument("run_a", required=False)
+@click.argument("run_b", required=False)
+def perf_diff_cmd(history_dir, threshold, last_n, as_json, run_a, run_b,
+                  fail_on_regression):
+    """Diff two recorded runs: spans, byte counters, cache hit ratios.
+
+    RUN_A is the baseline, RUN_B the candidate — ids, unique id
+    prefixes, negative indices (-1 = latest) or paths to record/manifest
+    JSON files. `--last 2` compares the two most recent records."""
+    from ..observe import history
+
+    if last_n is not None:
+        if last_n < 2:
+            raise click.ClickException("--last wants >= 2 (two runs)")
+        run_a, run_b = str(-last_n), "-1"
+    if not run_a or not run_b:
+        raise click.ClickException("need RUN_A and RUN_B (or --last 2)")
+    try:
+        a = history.load_record(run_a, history_dir)
+        b = history.load_record(run_b, history_dir)
+    except (FileNotFoundError, KeyError, IndexError) as e:
+        raise click.ClickException(str(e))
+    rep = history.diff(a, b, threshold_pct=threshold)
+    if as_json:
+        click.echo(_json.dumps(rep, indent=1, default=str))
+    else:
+        w = rep["wall_clock"]
+        click.echo(f"perf-diff {rep['a']}  ->  {rep['b']} "
+                   f"(threshold {threshold}%)")
+        click.echo(f"wall clock: {w['a_s']}s -> {w['b_s']}s "
+                   f"({w['delta_s']:+}s"
+                   + (f", {w['delta_pct']:+}%" if w["delta_pct"]
+                      is not None else "") + ")")
+        changed = [r for r in rep["spans"]
+                   if abs(r["delta_s"]) >= 0.001]
+        if changed:
+            click.echo("spans (total_s):")
+            for r in sorted(changed, key=lambda r: -abs(r["delta_s"]))[:20]:
+                mark = "  REGRESSION" if r.get("regression") else ""
+                pct = (f" ({r['delta_pct']:+}%)"
+                       if r["delta_pct"] is not None else "")
+                click.echo(f"  {r['span']:<32} {r['a_s']:>9} -> "
+                           f"{r['b_s']:>9}  {r['delta_s']:+}s{pct}{mark}")
+        moved = [r for r in rep["byte_counters"] if r["delta"]]
+        if moved:
+            click.echo("byte counters:")
+            for r in sorted(moved, key=lambda r: -abs(r["delta"]))[:20]:
+                mark = "  REGRESSION" if r.get("regression") else ""
+                click.echo(f"  {r['metric']:<48} "
+                           f"{_fmt_bytes(r['a'])} -> {_fmt_bytes(r['b'])}"
+                           f"{mark}")
+        for r in rep["caches"]:
+            mark = "  REGRESSION" if r.get("regression") else ""
+            click.echo(f"cache {r['cache']}: hit ratio "
+                       f"{r['a_hit_ratio']} -> {r['b_hit_ratio']}{mark}")
+        n = len(rep["regressions"])
+        click.echo(f"{n} regression(s) flagged" if n else
+                   "no regressions at this threshold")
+    if fail_on_regression and rep["regressions"]:
+        sys.exit(2)

@@ -32,6 +32,10 @@ def _socket_opt(f):
 @click.option("--idle-timeout", "idle_timeout", type=int, default=None,
               help="exit after this many idle seconds "
                    "(default: BST_SERVE_IDLE_TIMEOUT; 0 = never)")
+@click.option("--metrics-port", "metrics_port", type=int, default=None,
+              help="port of the live HTTP exporter (/metrics /healthz "
+                   "/status /jobs on 127.0.0.1); 0 picks a free port, "
+                   "default: BST_METRICS_PORT (whose 0 means off)")
 @click.option("--detach", is_flag=True, default=False,
               help="start the daemon as a background process and return "
                    "once it answers ping")
@@ -39,8 +43,8 @@ def _socket_opt(f):
               help="ask the daemon on --socket to drain and exit")
 @click.option("--status", is_flag=True, default=False,
               help="ping the daemon and print its status")
-def serve_cmd(socket_path, slots, jobs_root, idle_timeout, detach, stop,
-              status):
+def serve_cmd(socket_path, slots, jobs_root, idle_timeout, metrics_port,
+              detach, stop, status):
     """Run (or manage) the persistent stitching daemon.
 
     The daemon owns the device mesh and every process-wide cache
@@ -60,11 +64,17 @@ def serve_cmd(socket_path, slots, jobs_root, idle_timeout, detach, stop,
     if detach:
         pid = daemon.spawn_detached(socket_path, slots=slots,
                                     jobs_root=jobs_root,
-                                    idle_timeout=idle_timeout)
-        click.echo(f"serve: daemon ready (pid {pid})")
+                                    idle_timeout=idle_timeout,
+                                    metrics_port=metrics_port)
+        pong = client.ping(socket_path)
+        port = pong.get("metrics_port")
+        click.echo(f"serve: daemon ready (pid {pid})"
+                   + (f", live exporter http://127.0.0.1:{port}"
+                      if port else ""))
         return
     daemon.run_foreground(socket_path, slots=slots, jobs_root=jobs_root,
-                          idle_timeout=idle_timeout)
+                          idle_timeout=idle_timeout,
+                          metrics_port=metrics_port)
 
 
 def _parse_sets(pairs) -> dict:
@@ -200,6 +210,8 @@ def jobs_cmd(socket_path, as_json):
             line += f" run {j['seconds']}s"
         if j.get("exit_code") is not None:
             line += f" exit {j['exit_code']}"
+        if j.get("stalled"):
+            line += f" STALLED {j.get('stalled_for_s', '?')}s"
         if j.get("waiting_on"):
             line += f" after {','.join(j['waiting_on'])}"
         click.echo(line)

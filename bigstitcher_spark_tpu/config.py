@@ -159,6 +159,17 @@ _knob("BST_TRACE_PATH", "str", None,
       "Explicit output path for the --trace Perfetto JSON. Default: "
       "trace-{process}.json in the telemetry dir when one is set, else "
       "./bst-trace.json.")
+_knob("BST_METRICS_PORT", "int", 0,
+      "TCP port of the embedded live HTTP exporter (observe/httpexport.py: "
+      "/metrics Prometheus text, /healthz liveness, /status + /jobs JSON) "
+      "on 127.0.0.1; 0 disables. The `bst serve` daemon and long one-shot "
+      "runs both honor it; `bst serve --metrics-port 0` asks the OS for a "
+      "free port instead.")
+_knob("BST_HISTORY_DIR", "str", None,
+      "Directory of the cross-run manifest history store "
+      "(observe/history.py): every finalized run/job manifest appends a "
+      "compact record there for `bst history` / `bst perf-diff` (and, "
+      "eventually, `bst tune` replay). Unset disables recording.")
 
 # -- serve daemon ----------------------------------------------------------
 _knob("BST_SERVE_SOCKET", "str", None,
@@ -174,6 +185,12 @@ _knob("BST_SERVE_IDLE_TIMEOUT", "int", 0,
       "Seconds of no connections AND no jobs after which a `bst serve` "
       "daemon exits on its own (0 = run until shutdown). CI smoke runs "
       "set it so a crashed client can never leak a resident daemon.")
+_knob("BST_STALL_TIMEOUT_S", "int", 300,
+      "Stall watchdog threshold of the `bst serve` daemon: a RUNNING job "
+      "whose stage.progress has not advanced for this many seconds is "
+      "flagged `stalled` (bst_serve_jobs_stalled gauge, a job.stall warn "
+      "event on its sink, non-200 /healthz) until progress resumes or it "
+      "is cancelled. 0 disables the watchdog.")
 
 # -- streaming stage-DAG executor (dag/) -----------------------------------
 _knob("BST_DAG_EXCHANGE_BYTES", "bytes", 256 << 20,

@@ -135,7 +135,23 @@ def finalize(tool: str | None = None, params: dict | None = None,
         profiling.enable(False)
     _STATE.update(dir=None, started_at=None, metrics_baseline=None,
                   enabled_profiling=False)
+    _record_history(path)
     return path
+
+
+def _record_history(manifest_path: str | None,
+                    job: str | None = None) -> None:
+    """Append a finalized manifest to the BST_HISTORY_DIR store (no-op
+    when the knob is unset); history IO must never fail the run it
+    records."""
+    if manifest_path is None:
+        return
+    try:
+        from . import history
+
+        history.record_manifest(manifest_path, job=job)
+    except Exception:
+        pass
 
 
 class JobRun:
@@ -215,7 +231,7 @@ class JobRun:
                         "max_s": round(s.max_s, 3),
                         "min_s": round(s.min_s, 3)}
         reg = metrics.get_registry()
-        return manifest.write_manifest(
+        path = manifest.write_manifest(
             self.dir,
             tool=self.tool,
             argv=argv if argv is not None else [],
@@ -230,3 +246,5 @@ class JobRun:
             stages=progress.take_records(self.label),
             events_file=os.path.basename(ev_path) if ev_path else None,
         )
+        _record_history(path, job=self.label)
+        return path

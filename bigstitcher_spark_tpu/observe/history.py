@@ -1,0 +1,261 @@
+"""Cross-run manifest history store + performance regression diff.
+
+Run manifests die with their telemetry directory: two runs of the same
+workload land in two unrelated file trees and nothing compares them.
+ROADMAP items 1 and 5b (queue-aware autotuning, ``bst tune`` replaying
+manifests) need a durable cross-run record, and so does any human asking
+"did yesterday's change make fusion slower?" — the performance-
+portability question SparkCL answers by *measuring* each backend
+(PAPERS.md, arXiv 1505.01120).
+
+The store is a directory (``BST_HISTORY_DIR``): one compact JSON record
+per finalized run/job manifest (span table, metric deltas, stage
+summaries, device info — the numbers; argv/params ride along, the event
+logs do not) plus an append-only ``index.jsonl`` of one-line summaries.
+Appends are O_APPEND single-line writes, so concurrent processes (a
+daemon's jobs, a pod's ranks) interleave without locks and never tear
+the index. Recording is a no-op unless the knob is set, and history IO
+failures never fail the run being recorded.
+
+``bst history [list|show|add]`` browses and imports records; ``bst
+perf-diff`` compares two of them — span wall-clock, byte counters and
+cache hit ratios — against a configurable regression threshold. This is
+the substrate ``bst tune`` will replay.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+
+from . import metrics as _metrics
+from .. import config
+
+SCHEMA = "bst-history-record/1"
+
+_RECORDS = _metrics.counter("bst_history_records_total")
+
+_seq_lock = threading.Lock()
+_seq = 0
+
+# manifest keys copied into a history record verbatim — the numeric
+# surface perf-diff / bst tune consume, minus the heavyweight pointers
+# (event logs, traces) that stay in the telemetry dir
+_KEEP = ("tool", "argv", "params", "world", "device", "started_at",
+         "seconds", "status", "error", "spans", "metrics", "stages")
+
+
+def history_dir(override: str | None = None) -> str | None:
+    d = override or config.get_str("BST_HISTORY_DIR")
+    return os.path.abspath(d) if d else None
+
+
+def _next_record_id(tool: str | None) -> str:
+    """Collision-free across processes without coordination: wall-clock
+    second + pid + a process-local sequence, prefixed by the tool name so
+    ``bst history list`` reads meaningfully."""
+    global _seq
+    with _seq_lock:
+        _seq += 1
+        n = _seq
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    return f"{(tool or 'run')}-{stamp}-p{os.getpid()}-{n:03d}"
+
+
+def record_manifest(manifest_path: str, *, job: str | None = None,
+                    directory: str | None = None) -> str | None:
+    """Append one finalized manifest to the history store; returns the
+    record id, or None when no history dir is configured. Never raises
+    past IO problems to the caller's caller — the finalize paths wrap
+    this in a broad except, and so should any other producer."""
+    d = history_dir(directory)
+    if d is None:
+        return None
+    with open(manifest_path, encoding="utf-8") as f:
+        doc = json.load(f)
+    rid = _next_record_id(doc.get("tool") or (job and "job"))
+    rec = {"schema": SCHEMA, "id": rid,
+           "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "source_manifest": os.path.abspath(manifest_path)}
+    if job is not None:
+        rec["job"] = job
+    rec.update({k: doc[k] for k in _KEEP if k in doc})
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, rid + ".json")
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(rec, f, indent=1, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+    line = json.dumps({"id": rid, "ts": rec["recorded_at"],
+                       "tool": rec.get("tool"), "job": job,
+                       "status": rec.get("status"),
+                       "seconds": rec.get("seconds"),
+                       "file": os.path.basename(path)})
+    with open(os.path.join(d, "index.jsonl"), "a", encoding="utf-8") as f:
+        f.write(line + "\n")   # one line, O_APPEND: concurrency-safe
+    _RECORDS.inc()
+    return rid
+
+
+def list_records(directory: str | None = None) -> list[dict]:
+    """Index entries, oldest first; [] when the store exists but is
+    empty. Raises FileNotFoundError when no history dir is configured."""
+    d = history_dir(directory)
+    if d is None:
+        raise FileNotFoundError(
+            "no history dir: set BST_HISTORY_DIR or pass --history-dir")
+    idx = os.path.join(d, "index.jsonl")
+    out: list[dict] = []
+    if os.path.exists(idx):
+        with open(idx, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue   # torn line from a crashed writer
+    return out
+
+
+def load_record(ref: str, directory: str | None = None) -> dict:
+    """One record by exact id, unique id prefix, negative index ("-1" =
+    most recent), or a direct path to a record/manifest JSON file."""
+    if os.path.sep in ref or os.path.exists(ref):
+        with open(ref, encoding="utf-8") as f:
+            return json.load(f)
+    entries = list_records(directory)
+    try:
+        i = int(ref)
+        if i < 0:
+            ref = entries[i]["id"]   # IndexError -> KeyError below
+    except (ValueError, IndexError):
+        pass
+    matches = [e for e in entries if e["id"] == ref]
+    if not matches:
+        matches = [e for e in entries if e["id"].startswith(ref)]
+    if not matches:
+        raise KeyError(f"no history record matching {ref!r}")
+    if len(matches) > 1:
+        raise KeyError(f"{ref!r} is ambiguous: "
+                       f"{[e['id'] for e in matches[:5]]}")
+    d = history_dir(directory)
+    with open(os.path.join(d, matches[0]["file"]), encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _flat_metrics(rec: dict) -> dict[str, float]:
+    """Numeric metric series of a record (histogram dicts flatten to
+    their _sum/_count pair so they diff like any counter)."""
+    out: dict[str, float] = {}
+    for k, v in (rec.get("metrics") or {}).items():
+        if isinstance(v, dict):
+            if "sum" in v:
+                out[k + "_sum"] = float(v["sum"])
+            if "count" in v:
+                out[k + "_count"] = float(v["count"])
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = float(v)
+    return out
+
+
+def _ratio(flat: dict[str, float], hits: str, misses: str) -> float | None:
+    h = sum(v for k, v in flat.items() if k.split("{")[0] == hits)
+    m = sum(v for k, v in flat.items() if k.split("{")[0] == misses)
+    return h / (h + m) if (h + m) > 0 else None
+
+
+def diff(a: dict, b: dict, *, threshold_pct: float = 20.0,
+         min_seconds: float = 0.05, min_bytes: int = 1 << 20) -> dict:
+    """Compare run ``b`` against baseline ``a``: span wall-clock totals,
+    byte/op counters and cache hit ratios. A *regression* is ``b`` worse
+    than ``a`` by more than ``threshold_pct`` percent AND by more than
+    the absolute noise floor (``min_seconds`` for spans, ``min_bytes``
+    for byte counters; hit ratios regress when they drop by more than
+    ``threshold_pct`` percentage points)."""
+    thr = threshold_pct / 100.0
+    regressions: list[dict] = []
+
+    spans = []
+    sa = a.get("spans") or {}
+    sb = b.get("spans") or {}
+    for name in sorted(set(sa) | set(sb)):
+        ta = float((sa.get(name) or {}).get("total_s") or 0.0)
+        tb = float((sb.get(name) or {}).get("total_s") or 0.0)
+        row = {"span": name, "a_s": round(ta, 3), "b_s": round(tb, 3),
+               "delta_s": round(tb - ta, 3),
+               "delta_pct": (round((tb - ta) / ta * 100, 1) if ta > 0
+                             else None)}
+        if tb - ta > min_seconds and (ta <= 0 or tb > ta * (1 + thr)):
+            row["regression"] = True
+            regressions.append({"kind": "span", **row})
+        spans.append(row)
+
+    fa, fb = _flat_metrics(a), _flat_metrics(b)
+    counters = []
+    for key in sorted(set(fa) | set(fb)):
+        base = key.split("{")[0]
+        if not (base.endswith("_bytes_total") or base.endswith("_bytes")):
+            continue
+        va, vb = fa.get(key, 0.0), fb.get(key, 0.0)
+        row = {"metric": key, "a": int(va), "b": int(vb),
+               "delta": int(vb - va),
+               "delta_pct": (round((vb - va) / va * 100, 1) if va > 0
+                             else None)}
+        if vb - va > min_bytes and (va <= 0 or vb > va * (1 + thr)):
+            row["regression"] = True
+            regressions.append({"kind": "bytes", **row})
+        counters.append(row)
+
+    caches = []
+    for label, hits, misses in (
+            ("chunk_cache", "bst_chunk_cache_hits_total",
+             "bst_chunk_cache_misses_total"),
+            ("tile_cache", "bst_tile_cache_hits_total",
+             "bst_tile_cache_misses_total")):
+        ra, rb = _ratio(fa, hits, misses), _ratio(fb, hits, misses)
+        if ra is None and rb is None:
+            continue
+        row = {"cache": label,
+               "a_hit_ratio": round(ra, 4) if ra is not None else None,
+               "b_hit_ratio": round(rb, 4) if rb is not None else None}
+        if ra is not None and rb is not None \
+                and (ra - rb) * 100 > threshold_pct:
+            row["regression"] = True
+            regressions.append({"kind": "cache", **row})
+        caches.append(row)
+
+    wa = float(a.get("seconds") or 0.0)
+    wb = float(b.get("seconds") or 0.0)
+    wall = {"a_s": round(wa, 3), "b_s": round(wb, 3),
+            "delta_s": round(wb - wa, 3),
+            "delta_pct": round((wb - wa) / wa * 100, 1) if wa > 0 else None}
+    if wb - wa > min_seconds and wa > 0 and wb > wa * (1 + thr):
+        wall["regression"] = True
+        regressions.append({"kind": "wall_clock", **wall})
+
+    return {"a": a.get("id") or a.get("tool"),
+            "b": b.get("id") or b.get("tool"),
+            "threshold_pct": threshold_pct,
+            "wall_clock": wall,
+            "spans": spans,
+            "byte_counters": counters,
+            "caches": caches,
+            "regressions": regressions}
+
+
+def import_path(path: str, directory: str | None = None) -> list[str]:
+    """``bst history add``: import manifest file(s) — a single JSON file
+    or a telemetry directory's ``manifest-*.json`` set — into the store;
+    returns the new record ids."""
+    paths = (sorted(glob.glob(os.path.join(path, "manifest-*.json")))
+             if os.path.isdir(path) else [path])
+    if not paths:
+        raise FileNotFoundError(f"no manifest-*.json under {path}")
+    return [rid for p in paths
+            if (rid := record_manifest(p, directory=directory)) is not None]

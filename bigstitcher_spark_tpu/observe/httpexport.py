@@ -1,0 +1,265 @@
+"""Embedded live HTTP exporter: /metrics, /healthz, /status, /jobs.
+
+Until now every telemetry artifact was end-of-run: the Prometheus
+textfile, the run manifest and the Perfetto trace all materialize at
+``observe.finalize()``. That was fine for one-shot tools and is blind
+for the resident ``bst serve`` daemon and long streamed pipelines — a
+stalled job, a starved dag consumer or a leaking cache in a process that
+never exits is invisible. This module is the live view: a stdlib
+``http.server`` bound to 127.0.0.1 (``BST_METRICS_PORT``; 0 = off)
+serving
+
+- ``/metrics`` — the SAME ``MetricsRegistry.render_prometheus()`` text
+  the end-of-run textfile contains, scraped live, plus process
+  self-gauges (uptime, RSS, thread count, open FDs) refreshed per
+  scrape;
+- ``/healthz`` — liveness JSON, HTTP 200 when healthy and 503 when not
+  (the daemon wires mesh liveness, slot-loop heartbeat age and the
+  stall watchdog's stalled-job count in here; a bare one-shot process
+  is healthy as long as it answers);
+- ``/status`` — one JSON status object (daemon queue/cache/dag state,
+  or generic process + trace state outside a daemon);
+- ``/jobs`` — the job table (empty outside a daemon).
+
+The server is one module-level singleton so the daemon and the CLI
+bootstrapping path never race two exporters onto one port; *providers*
+(status/health/jobs callables) are swappable at runtime — the daemon
+attaches its own on start and detaches them on drain, leaving the
+generic process view for whatever outlives it. Handlers run on the
+ThreadingHTTPServer's daemon threads, so a scrape can never block (or be
+blocked by) job execution — the registry render takes the registry lock
+exactly like the end-of-run textfile writer does.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+import time
+
+from . import metrics as _metrics
+from .. import config
+
+_PROC_START = time.time()
+
+_UPTIME = _metrics.gauge("bst_process_uptime_seconds")
+_RSS = _metrics.gauge("bst_process_rss_bytes")
+_THREADS = _metrics.gauge("bst_process_threads")
+_FDS = _metrics.gauge("bst_process_open_fds")
+
+
+def _rss_bytes() -> int | None:
+    """Resident-set size via /proc (linux); None where unavailable."""
+    try:
+        with open("/proc/self/statm", encoding="ascii") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGESIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _open_fds() -> int | None:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+def process_stats() -> dict:
+    """Uptime / RSS / threads / open-FD snapshot of THIS process,
+    refreshed into the registry gauges so the same numbers appear in
+    ``/metrics`` scrapes and end-of-run textfiles."""
+    up = time.time() - _PROC_START
+    rss = _rss_bytes()
+    nthreads = threading.active_count()
+    fds = _open_fds()
+    _UPTIME.set(round(up, 3))
+    _THREADS.set(nthreads)
+    if rss is not None:
+        _RSS.set(rss)
+    if fds is not None:
+        _FDS.set(fds)
+    out = {"pid": os.getpid(), "uptime_s": round(up, 1),
+           "threads": nthreads}
+    if rss is not None:
+        out["rss_bytes"] = rss
+    if fds is not None:
+        out["open_fds"] = fds
+    return out
+
+
+# -- providers ---------------------------------------------------------------
+# status() -> dict; health() -> (ok: bool, payload: dict); jobs() -> list.
+# The daemon swaps its own in; the defaults describe a bare process.
+
+_plock = threading.Lock()
+_PROVIDERS: dict = {"status": None, "health": None, "jobs": None}
+
+
+def set_providers(status=None, health=None, jobs=None) -> None:
+    with _plock:
+        if status is not None:
+            _PROVIDERS["status"] = status
+        if health is not None:
+            _PROVIDERS["health"] = health
+        if jobs is not None:
+            _PROVIDERS["jobs"] = jobs
+
+
+def clear_providers() -> None:
+    with _plock:
+        _PROVIDERS.update(status=None, health=None, jobs=None)
+
+
+def _provider(name: str):
+    with _plock:
+        return _PROVIDERS[name]
+
+
+def _default_status() -> dict:
+    from . import trace as _trace
+    from . import telemetry_dir as _tdir  # type: ignore[attr-defined]
+
+    return {"process": process_stats(), "trace": _trace.stats(),
+            "telemetry_dir": _tdir()}
+
+
+def _default_health() -> tuple[bool, dict]:
+    return True, {"ok": True, "uptime_s": round(time.time() - _PROC_START, 1)}
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_version = "bst-exporter/1"
+
+    def log_message(self, *args) -> None:   # no stderr chatter per scrape
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, doc) -> None:
+        body = (json.dumps(doc, indent=1, default=str) + "\n").encode()
+        self._send(code, body, "application/json")
+
+    def do_GET(self) -> None:   # noqa: N802 — BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                _metrics.counter("bst_http_requests_total",
+                                 endpoint="metrics").inc()
+                process_stats()   # refresh the self-gauges pre-render
+                body = _metrics.get_registry().render_prometheus().encode()
+                self._send(200, body, "text/plain; version=0.0.4")
+            elif path == "/healthz":
+                _metrics.counter("bst_http_requests_total",
+                                 endpoint="healthz").inc()
+                health = _provider("health") or _default_health
+                ok, payload = health()
+                self._send_json(200 if ok else 503, payload)
+            elif path in ("/status", "/"):
+                _metrics.counter("bst_http_requests_total",
+                                 endpoint="status").inc()
+                status = _provider("status")
+                doc = status() if status is not None else _default_status()
+                self._send_json(200, doc)
+            elif path == "/jobs":
+                _metrics.counter("bst_http_requests_total",
+                                 endpoint="jobs").inc()
+                jobs = _provider("jobs")
+                self._send_json(200, {"jobs": jobs() if jobs is not None
+                                      else []})
+            else:
+                self._send_json(404, {"error": f"no such endpoint {path!r}",
+                                      "endpoints": ["/metrics", "/healthz",
+                                                    "/status", "/jobs"]})
+        except (BrokenPipeError, ConnectionResetError):
+            pass   # scraper went away mid-response
+        except Exception as e:   # a broken provider must not kill the server
+            try:
+                self._send_json(500, {"error": repr(e)[:500]})
+            except OSError:
+                pass
+
+
+class Exporter:
+    """One running HTTP exporter; ``stop()`` shuts the server down and
+    joins its accept thread."""
+
+    def __init__(self, server: http.server.ThreadingHTTPServer,
+                 thread: threading.Thread):
+        self._server = server
+        self._thread = thread
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=10)
+
+
+_elock = threading.Lock()
+_EXPORTER: Exporter | None = None
+
+
+def active() -> Exporter | None:
+    return _EXPORTER
+
+
+def start(port: int, host: str = "127.0.0.1") -> Exporter:
+    """Bind and serve on ``host:port`` (``port=0`` asks the OS for a free
+    one — note the knob path treats 0 as OFF; programmatic/explicit-flag
+    callers use 0 for ephemeral test/doc daemons). Returns the existing
+    exporter when one is already running (singleton)."""
+    global _EXPORTER
+    with _elock:
+        if _EXPORTER is not None:
+            return _EXPORTER
+        srv = http.server.ThreadingHTTPServer((host, int(port)), _Handler)
+        srv.daemon_threads = True
+        th = threading.Thread(target=srv.serve_forever,
+                              name="bst-http-exporter", daemon=True)
+        th.start()
+        _EXPORTER = Exporter(srv, th)
+        return _EXPORTER
+
+
+def ensure_started() -> Exporter | None:
+    """Knob-driven idempotent start: BST_METRICS_PORT > 0 starts (or
+    returns) the exporter, anything else is off. Bind failures are
+    reported, never fatal — losing the live view must not kill a run."""
+    if _EXPORTER is not None:
+        return _EXPORTER
+    port = config.get_int("BST_METRICS_PORT") or 0
+    if port <= 0:
+        return None
+    try:
+        return start(port)
+    except OSError as e:
+        from . import log as _log  # type: ignore[attr-defined]
+
+        _log(f"live exporter disabled: cannot bind port {port}: {e}",
+             stage="observe")
+        return None
+
+
+def stop() -> None:
+    """Stop the exporter (if running) and drop the singleton."""
+    global _EXPORTER
+    with _elock:
+        exp = _EXPORTER
+        _EXPORTER = None
+    if exp is not None:
+        exp.stop()

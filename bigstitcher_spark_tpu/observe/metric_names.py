@@ -78,6 +78,18 @@ METRICS: dict[str, str] = {
         "kernel-bucket requests served by an already-built compiled fn",
     "bst_compiled_fn_cold_builds_total":
         "kernel-bucket requests that built (compiled) a new fn",
+    # live HTTP exporter + process self-gauges (observe/httpexport.py):
+    # refreshed at every scrape so a dashboard sees the resident process
+    # itself, not only its workload
+    "bst_process_uptime_seconds": "seconds since this process started",
+    "bst_process_rss_bytes": "resident-set size of this process",
+    "bst_process_threads": "live thread count of this process",
+    "bst_process_open_fds": "open file descriptors of this process",
+    "bst_http_requests_total":
+        "live-exporter HTTP requests served, labeled by endpoint",
+    # manifest history store (observe/history.py)
+    "bst_history_records_total":
+        "run/job manifests appended to the BST_HISTORY_DIR history store",
     # serve daemon (serve/): queue + lifecycle + per-job cache warmth
     "bst_serve_jobs_submitted_total": "jobs accepted by the serve daemon",
     "bst_serve_jobs_completed_total":
@@ -89,6 +101,9 @@ METRICS: dict[str, str] = {
     "bst_serve_compile_warm_hits_total":
         "per-job warm compiled-fn bucket hits observed by the daemon "
         "(the amortized-compile win of a resident process)",
+    "bst_serve_jobs_stalled":
+        "RUNNING jobs whose stage.progress has not advanced for "
+        "BST_STALL_TIMEOUT_S (the stall watchdog's live gauge)",
     # streaming stage-DAG executor (dag/): producer->consumer block
     # exchange that replaces intermediate-container round-trips
     "bst_dag_blocks_streamed_total":
@@ -169,6 +184,10 @@ SPANS: dict[str, str] = {
     "serve.submit": "a job was accepted into the queue (instant)",
     "serve.cancel": "a cancel request was applied to a job (instant)",
     "serve.shutdown": "the daemon began draining/shutting down (instant)",
+    "serve.stall":
+        "the watchdog flagged a running job as stalled (instant)",
+    "serve.trace_dump":
+        "the live flight-recorder ring was snapshotted on demand (instant)",
     # streaming stage-DAG executor (dag/executor.py, dag/stream.py)
     "dag.stage": "one pipeline stage's full execution on its thread",
     "dag.wait":

@@ -263,6 +263,11 @@ def export(process_index: int = 0, process_count: int = 1) -> dict:
 
 
 def dump(path: str) -> str:
+    """Write the ring's CURRENT contents as Perfetto JSON to ``path``
+    WITHOUT stopping the recorder — :func:`export` copies the buffer
+    under the ring lock, so the snapshot is consistent while events keep
+    flowing (the on-demand ``bst trace-dump`` path; :func:`finalize` is
+    the end-of-run variant that also stops recording)."""
     from . import events as _events
 
     pi, pc = _events.world()
@@ -270,10 +275,24 @@ def dump(path: str) -> str:
     d = os.path.dirname(os.path.abspath(path))
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
+    # pid AND thread id: two concurrent daemon-op dumps to one path must
+    # not interleave into a shared temp file
+    tmp = f"{path}.tmp{os.getpid()}-{threading.get_ident()}"
+    with open(tmp, "w", encoding="utf-8") as f:
         json.dump(doc, f, default=str)
         f.write("\n")
+    os.replace(tmp, path)   # a live dump must never expose a torn file
     return path
+
+
+def dump_live(path: str) -> str:
+    """:func:`dump` with an explicit not-recording error — the daemon op
+    / CLI surface of the on-demand flight-recorder snapshot."""
+    if not _STATE["enabled"]:
+        raise RuntimeError(
+            "flight recorder is not recording — enable it with --trace / "
+            "BST_TRACE=1 (the serve daemon records always)")
+    return dump(path)
 
 
 def finalize(dir_hint: str | None = None) -> str | None:

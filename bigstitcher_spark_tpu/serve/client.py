@@ -36,6 +36,21 @@ def list_jobs(socket_path: str | None = None) -> dict:
     return {"daemon": resp.get("daemon", {}), "jobs": resp.get("jobs", [])}
 
 
+def status(socket_path: str | None = None) -> dict:
+    """The daemon's full live status object (what /status also serves)."""
+    return _one_shot(socket_path, {"op": "status"}).get("status", {})
+
+
+def trace_dump(socket_path: str | None = None,
+               out: str | None = None) -> dict:
+    """Snapshot the daemon's live flight-recorder ring to Perfetto JSON
+    (jobs keep running); returns ``{"path": ..., recorder stats...}``."""
+    req: dict = {"op": "trace-dump"}
+    if out:
+        req["out"] = out
+    return _one_shot(socket_path, req)
+
+
 def cancel(socket_path: str | None, job_id: str) -> dict:
     return _one_shot(socket_path, {"op": "cancel", "job": job_id})
 

@@ -39,16 +39,18 @@ import threading
 import time
 
 from .. import config, observe, profiling
-from ..observe import events, metrics as _metrics, trace as _trace
+from ..observe import events, httpexport, metrics as _metrics, \
+    trace as _trace
 from ..utils import cancel as _cancel
 from ..utils.threads import ctx_thread
 from . import protocol
 from .jobs import CANCELLED, DONE, FAILED, QUEUED, RUNNING, Job, JobQueue
 
 # tools a job may NOT be: the serve surface itself (a job submitting jobs
-# recurses), plus flags that would re-enter the process-global telemetry
-# lifecycle under the daemon's feet
-_BLOCKED_TOOLS = {"serve", "submit", "jobs", "cancel"}
+# recurses; `top` would follow its own daemon forever), plus flags that
+# would re-enter the process-global telemetry lifecycle under the
+# daemon's feet
+_BLOCKED_TOOLS = {"serve", "submit", "jobs", "cancel", "top", "trace-dump"}
 _BLOCKED_FLAGS = {"--telemetry-dir", "--profile", "--trace"}
 
 _WARM_HITS = _metrics.counter("bst_serve_compile_warm_hits_total")
@@ -56,7 +58,14 @@ _WARM_HITS = _metrics.counter("bst_serve_compile_warm_hits_total")
 # events forwarded to following submit clients (everything else stays in
 # the job's JSONL only — a chatty fusion log must not flood the socket)
 _STREAMED_EVENTS = {"job.start", "job.end", "stage.start", "stage.progress",
-                    "stage.end", "log", "retry.round", "pair.redispatch"}
+                    "stage.end", "log", "retry.round", "pair.redispatch",
+                    "job.stall", "job.resume"}
+
+_STALLED = _metrics.gauge("bst_serve_jobs_stalled")
+
+# a slot loop that is IDLE (no job) must touch its heartbeat at least
+# every take() timeout; past this age the loop thread is presumed dead
+_SLOT_DEAD_AFTER_S = 15.0
 
 
 class _StdoutRouter(io.TextIOBase):
@@ -131,7 +140,8 @@ class Daemon:
     def __init__(self, socket_path: str | None = None,
                  slots: int | None = None,
                  jobs_root: str | None = None,
-                 idle_timeout: float | None = None):
+                 idle_timeout: float | None = None,
+                 metrics_port: int | None = None):
         self.socket_path = socket_path or protocol.default_socket_path()
         self.slots = slots if slots is not None else \
             max(1, config.get_int("BST_SERVE_SLOTS") or 1)
@@ -140,6 +150,12 @@ class Daemon:
         self.idle_timeout = (idle_timeout if idle_timeout is not None
                              else config.get_int("BST_SERVE_IDLE_TIMEOUT")
                              or 0)
+        # live HTTP exporter: None reads BST_METRICS_PORT (whose 0 means
+        # OFF); an EXPLICIT 0 (CLI --metrics-port 0, tests) asks the OS
+        # for a free ephemeral port instead — the resolved port lands in
+        # self.metrics_port / the ping response
+        self._metrics_port_arg = metrics_port
+        self.metrics_port = 0
         self.queue = JobQueue(self.slots)
         self.started_at = time.time()
         self._sock: socket.socket | None = None
@@ -148,17 +164,29 @@ class Daemon:
         self._stop = threading.Event()
         self._drained = threading.Event()
         self._job_seq = 0
+        self._dump_seq = 0
         self._last_activity = time.monotonic()
         self._router: _StdoutRouter | None = None
         self._inflight_base: int | None = None
         self._pair_base: int | None = None
         self.device_info: dict = {}
+        self._slot_seen = [time.monotonic()] * self.slots
+        self._slot_busy = [False] * self.slots
+        self._own_exporter = False
+        self._own_trace = False
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "Daemon":
         os.makedirs(self.jobs_root, exist_ok=True)
         self._warm_mesh()
+        # a resident process records its flight recorder ALWAYS (bounded
+        # ring, newest-wins): `bst trace-dump` can then snapshot the last
+        # BST_TRACE_BUFFER_BYTES of timeline at any point without anyone
+        # having thought to pass --trace before the incident
+        if not _trace.enabled():
+            _trace.configure()
+            self._own_trace = True
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         with contextlib.suppress(OSError):
             os.unlink(self.socket_path)
@@ -168,6 +196,7 @@ class Daemon:
         self._sock = s
         with self._lock:
             self._router = _StdoutRouter()   # installs itself per job
+        self._start_exporter()
         for slot in range(self.slots):
             th = ctx_thread(self._slot_loop, (slot,),
                             name=f"bst-serve-slot-{slot}")
@@ -176,11 +205,41 @@ class Daemon:
         th = ctx_thread(self._accept_loop, (), name="bst-serve-accept")
         th.start()
         self._threads.append(th)
+        th = ctx_thread(self._watchdog_loop, (), name="bst-serve-watchdog")
+        th.start()
+        self._threads.append(th)
         observe.log(f"bst serve: listening on {self.socket_path} "
                     f"({self.slots} slot(s), "
                     f"{self.device_info.get('local_device_count', '?')} "
                     f"device(s))", stage="serve")
+        if self.metrics_port:
+            observe.log(f"bst serve: live exporter on "
+                        f"http://127.0.0.1:{self.metrics_port} "
+                        f"(/metrics /healthz /status /jobs)",
+                        stage="serve")
         return self
+
+    def _start_exporter(self) -> None:
+        """Bring the live HTTP exporter up (explicit port arg beats the
+        BST_METRICS_PORT knob) and point its providers at this daemon;
+        bind failure downgrades to socket-only serving, never a crash."""
+        exp = httpexport.active()
+        if exp is None:
+            try:
+                if self._metrics_port_arg is not None:
+                    exp = httpexport.start(self._metrics_port_arg)
+                else:
+                    exp = httpexport.ensure_started()
+                self._own_exporter = exp is not None
+            except OSError as e:
+                observe.log(f"bst serve: live exporter disabled "
+                            f"({e})", stage="serve")
+                return
+        if exp is not None:
+            httpexport.set_providers(status=self._status,
+                                     health=self._health,
+                                     jobs=self._jobs_payload)
+            self.metrics_port = exp.port
 
     def _warm_mesh(self) -> None:
         """Pay jax init + device placement ONCE, before accepting work;
@@ -243,6 +302,11 @@ class Daemon:
             self._router = None
         if router is not None and sys.stdout is router:
             sys.stdout = router._real   # no job left it installed
+        httpexport.clear_providers()
+        if self._own_exporter:
+            httpexport.stop()   # frees the port for the next daemon
+        if self._own_trace and _trace.enabled():
+            _trace.reset()      # leave the recorder as we found it
         self._drained.set()
 
     # -- accept / connection handling ----------------------------------------
@@ -285,19 +349,13 @@ class Daemon:
             if op == "ping":
                 protocol.send_line(f, {
                     "event": "pong", "pid": os.getpid(),
-                    "uptime_s": round(time.time() - self.started_at, 1),
+                    "uptime_s": self.uptime_s(),
+                    "metrics_port": self.metrics_port,
                     "device": self.device_info})
             elif op == "jobs":
-                rows = []
-                for j in self.queue.jobs():
-                    d = j.describe()
-                    open_ids = self.queue.waiting_on(j.id)
-                    if open_ids:
-                        d["waiting_on"] = sorted(open_ids)
-                    rows.append(d)
                 protocol.send_line(f, {"event": "jobs",
                                        "daemon": self._status(),
-                                       "jobs": rows})
+                                       "jobs": self._jobs_payload()})
             elif op == "cancel":
                 self._op_cancel(f, req)
             elif op == "shutdown":
@@ -308,6 +366,11 @@ class Daemon:
                               wait=False)
             elif op == "submit":
                 self._op_submit(f, req)
+            elif op == "status":
+                protocol.send_line(f, {"event": "status",
+                                       "status": self._status()})
+            elif op == "trace-dump":
+                self._op_trace_dump(f, req)
             else:
                 protocol.send_line(f, {"event": "error",
                                        "error": f"unknown op {op!r}"})
@@ -319,6 +382,15 @@ class Daemon:
             with contextlib.suppress(OSError):
                 conn.close()
 
+    def uptime_s(self) -> float:
+        """Daemon uptime — the ONE place it is computed (ping, /status
+        and `bst jobs --json` must all agree)."""
+        return round(time.time() - self.started_at, 1)
+
+    def _stalled_jobs(self) -> list[str]:
+        return [j.id for j in self.queue.jobs()
+                if j.stalled and j.state == RUNNING]
+
     def _status(self) -> dict:
         from ..io.chunkcache import get_cache
 
@@ -326,10 +398,15 @@ class Daemon:
             "pid": os.getpid(),
             "socket": self.socket_path,
             "slots": self.slots,
-            "uptime_s": round(time.time() - self.started_at, 1),
+            "uptime_s": self.uptime_s(),
+            "metrics_port": self.metrics_port,
             "queue_depth": self.queue.depth(),
             "active": self.queue.active(),
+            "stalled": self._stalled_jobs(),
             "device": self.device_info,
+            # the same process self-view the /metrics scrape refreshes,
+            # so `bst jobs --json` and /status literally agree
+            "process": httpexport.process_stats(),
             "share_runtime_s": {k: round(v, 3) for k, v in
                                 self.queue.share_runtime().items()},
             # warm-cache state: why the second submit is cheaper
@@ -340,7 +417,63 @@ class Daemon:
                 "cold_builds": _metrics.counter(
                     "bst_compiled_fn_cold_builds_total").value,
             },
+            # live frontier gauges: the in-flight HBM high-water and the
+            # streamed-pipeline exchange/stall state (a starved dag
+            # consumer shows up here while it is starving, not post-run)
+            "inflight": {
+                "bytes": _metrics.gauge("bst_inflight_bytes").value,
+                "highwater_bytes": _metrics.gauge(
+                    "bst_inflight_bytes_highwater").value,
+            },
+            "dag": {
+                "exchange_bytes": _metrics.gauge(
+                    "bst_dag_exchange_bytes").value,
+                "exchange_blocks": _metrics.gauge(
+                    "bst_dag_exchange_blocks").value,
+                "producer_stall_s": _metrics.counter(
+                    "bst_dag_producer_stall_seconds_total").value,
+                "consumer_wait_s": _metrics.counter(
+                    "bst_dag_consumer_wait_seconds_total").value,
+            },
+            "trace": _trace.stats(),
         }
+
+    def _health(self) -> tuple[bool, dict]:
+        """The /healthz verdict: 200 only while the mesh came up, every
+        slot loop's heartbeat is fresh (idle slots tick each take()
+        timeout; a busy slot is alive by definition), no running job is
+        stalled, and the daemon is not draining."""
+        now = time.monotonic()
+        stalled = self._stalled_jobs()
+        ages = [round(now - seen, 1) for seen in self._slot_seen]
+        dead_slots = [i for i in range(self.slots)
+                      if not self._slot_busy[i]
+                      and ages[i] > _SLOT_DEAD_AFTER_S]
+        mesh_ok = "error" not in self.device_info
+        draining = self._stop.is_set()
+        ok = mesh_ok and not stalled and not dead_slots and not draining
+        return ok, {
+            "ok": ok,
+            "uptime_s": self.uptime_s(),
+            "mesh_ok": mesh_ok,
+            "device": self.device_info,
+            "slot_heartbeat_age_s": ages,
+            "dead_slots": dead_slots,
+            "stalled_jobs": stalled,
+            "active": self.queue.active(),
+            "queue_depth": self.queue.depth(),
+            "draining": draining,
+        }
+
+    def _jobs_payload(self) -> list[dict]:
+        rows = []
+        for j in self.queue.jobs():
+            d = j.describe()
+            open_ids = self.queue.waiting_on(j.id)
+            if open_ids:
+                d["waiting_on"] = sorted(open_ids)
+            rows.append(d)
+        return rows
 
     def _op_cancel(self, f, req: dict) -> None:
         job = self.queue.get(str(req.get("job", "")))
@@ -362,6 +495,85 @@ class Daemon:
                 j.waiters.clear()
         protocol.send_line(f, {"event": "cancelled", "job": job.id,
                                "state": job.state})
+
+    def _op_trace_dump(self, f, req: dict) -> None:
+        """Snapshot the live flight-recorder ring to Perfetto JSON
+        without pausing jobs (the ring copy happens under the trace
+        lock; the recorder keeps recording)."""
+        out = req.get("out")
+        if not out:
+            with self._lock:
+                self._dump_seq += 1
+                n = self._dump_seq
+            out = os.path.join(self.jobs_root, f"trace-dump-{n:04d}.json")
+        try:
+            path = _trace.dump_live(os.path.abspath(str(out)))
+        except (RuntimeError, OSError) as e:
+            protocol.send_line(f, {"event": "error", "error": str(e)})
+            return
+        _trace.instant("serve.trace_dump", item=os.path.basename(path))
+        protocol.send_line(f, {"event": "trace-dump", "path": path,
+                               **_trace.stats()})
+
+    # -- stall watchdog ------------------------------------------------------
+
+    def _watchdog_loop(self) -> None:
+        """Flags RUNNING jobs whose stage.progress stopped advancing for
+        BST_STALL_TIMEOUT_S: raises the bst_serve_jobs_stalled gauge,
+        warns on the job's scoped event sink (and the follower stream),
+        and clears the flag the moment progress resumes. The knob is read
+        per sweep, so a long-lived daemon can be retuned live."""
+        while not self._stop.is_set():
+            timeout_s = config.get_int("BST_STALL_TIMEOUT_S") or 0
+            now = time.time()
+            stalled_n = 0
+            for job in self.queue.jobs():
+                # clearing runs even with the watchdog disabled: setting
+                # the knob to 0 must RELEASE stale stall state (flags,
+                # gauge, /healthz), not freeze it
+                if job.state != RUNNING or timeout_s <= 0:
+                    job.stalled = False
+                    continue
+                last = job.last_progress or job.started_at or now
+                is_stalled = now - last > timeout_s
+                if is_stalled:
+                    stalled_n += 1
+                if is_stalled and not job.stalled:
+                    job.stalled = True
+                    _trace.instant("serve.stall", item=job.id)
+                    self._emit_job_event(
+                        job, "job.stall",
+                        message=f"no stage.progress for "
+                                f"{round(now - last, 1)}s "
+                                f"(BST_STALL_TIMEOUT_S={timeout_s})",
+                        stalled_for_s=round(now - last, 1),
+                        # the streamed-exchange state diagnoses a
+                        # starved dag consumer live
+                        dag_exchange_bytes=_metrics.gauge(
+                            "bst_dag_exchange_bytes").value,
+                        dag_producer_stall_s=_metrics.counter(
+                            "bst_dag_producer_stall_seconds_total"
+                        ).value,
+                        dag_consumer_wait_s=_metrics.counter(
+                            "bst_dag_consumer_wait_seconds_total"
+                        ).value)
+                elif not is_stalled and job.stalled:
+                    job.stalled = False
+                    self._emit_job_event(job, "job.resume",
+                                         message="progress resumed")
+            _STALLED.set(stalled_n)
+            self._stop.wait(max(0.2, min(timeout_s / 4, 5.0))
+                            if timeout_s > 0 else 1.0)
+        _STALLED.set(0)
+
+    def _emit_job_event(self, job: Job, etype: str, **fields) -> None:
+        """Emit a daemon-side event INTO the job's scoped sink (and so
+        its follower stream) from the watchdog thread."""
+        token = events.activate_job(job.id)
+        try:
+            events.emit(etype, job=job.id, **fields)
+        finally:
+            events.deactivate_job(token)
 
     def _op_submit(self, f, req: dict) -> None:
         from ..cli.main import cli as _cli
@@ -456,13 +668,19 @@ class Daemon:
 
     def _slot_loop(self, slot: int) -> None:
         while True:
+            self._slot_seen[slot] = time.monotonic()
             job = self.queue.take(slot, timeout=0.5)
             if job is None:
                 if self._stop.is_set():
                     return
                 continue
             self._last_activity = time.monotonic()
-            self._run_job(slot, job)
+            self._slot_busy[slot] = True
+            try:
+                self._run_job(slot, job)
+            finally:
+                self._slot_busy[slot] = False
+                self._slot_seen[slot] = time.monotonic()
             self._last_activity = time.monotonic()
 
     def _run_job(self, slot: int, job: Job) -> None:
@@ -494,6 +712,9 @@ class Daemon:
                                                      "output.log"))
             with config.overrides(self._job_budget_overrides(job)), \
                     _cancel.scope(job.token), jobrun:
+                # the stall clock starts NOW: a job that never emits a
+                # heartbeat stalls timeout_s after start, not after epoch
+                job.last_progress = time.time()
                 self._notify(job, {"event": "start", "job": job.id,
                                    "slot": slot})
                 with profiling.span("serve.job", stage=job.tool,
@@ -553,9 +774,19 @@ class Daemon:
 
 def _streaming_forwarder(job: Job):
     """events->waiters bridge: forwards the heartbeat subset of a job's
-    event stream to every following client."""
+    event stream to every following client, and feeds the stall
+    watchdog's progress clock + `bst top`'s live progress row."""
     def cb(rec: dict) -> None:
-        if rec.get("type") in _STREAMED_EVENTS:
+        t = rec.get("type")
+        if t in ("stage.start", "stage.progress", "stage.end"):
+            job.last_progress = time.time()
+            if t == "stage.progress":
+                job.progress = {k: rec[k] for k in
+                                ("stage", "done", "total", "rate_per_s",
+                                 "eta_s") if k in rec}
+            elif t == "stage.end":
+                job.progress = None   # stage finished; row is stale
+        if t in _STREAMED_EVENTS:
             for w in list(job.waiters):
                 w.put({"event": "job-event", "job": job.id, **rec})
 
@@ -564,7 +795,8 @@ def _streaming_forwarder(job: Job):
 
 def run_foreground(socket_path: str | None = None, slots: int | None = None,
                    jobs_root: str | None = None,
-                   idle_timeout: float | None = None) -> int:
+                   idle_timeout: float | None = None,
+                   metrics_port: int | None = None) -> int:
     """``bst serve`` without --detach: start, block until shutdown.
 
     Signal handling lives HERE, not in Daemon.start(): only the
@@ -572,7 +804,7 @@ def run_foreground(socket_path: str | None = None, slots: int | None = None,
     requires) — an in-process daemon (tests, bench) must never hijack
     its host's SIGINT/SIGTERM. Previous handlers are restored on exit."""
     d = Daemon(socket_path, slots=slots, jobs_root=jobs_root,
-               idle_timeout=idle_timeout)
+               idle_timeout=idle_timeout, metrics_port=metrics_port)
     d.start()
     prev = {}
     if threading.current_thread() is threading.main_thread():
@@ -592,6 +824,7 @@ def run_foreground(socket_path: str | None = None, slots: int | None = None,
 def spawn_detached(socket_path: str | None = None, slots: int | None = None,
                    jobs_root: str | None = None,
                    idle_timeout: float | None = None,
+                   metrics_port: int | None = None,
                    ready_timeout: float = 180.0) -> int:
     """``bst serve --detach``: fork a daemon subprocess, wait until its
     socket answers ping, return its pid."""
@@ -616,6 +849,8 @@ def spawn_detached(socket_path: str | None = None, slots: int | None = None,
         args += ["--jobs-root", jobs_root]
     if idle_timeout is not None:
         args += ["--idle-timeout", str(int(idle_timeout))]
+    if metrics_port is not None:
+        args += ["--metrics-port", str(int(metrics_port))]
     log_path = path + ".log"
     with open(log_path, "ab") as logf:
         proc = subprocess.Popen(args, stdout=logf, stderr=logf, env=env,

@@ -88,6 +88,12 @@ class Job:
     warm_compile_hits: int = 0
     token: CancelToken = field(default_factory=CancelToken)
     waiters: list = field(default_factory=list)   # queue.Queue per client
+    # live-progress state the stall watchdog and `bst top` read: last
+    # wall-clock a stage.progress/start/end advanced, the latest progress
+    # payload, and whether the watchdog currently flags the job stalled
+    last_progress: float | None = None
+    progress: dict[str, Any] | None = None
+    stalled: bool = False
 
     def describe(self) -> dict[str, Any]:
         now = time.time()
@@ -118,6 +124,15 @@ class Job:
             d["telemetry_dir"] = self.telemetry_dir
         if self.warm_compile_hits:
             d["warm_compile_hits"] = self.warm_compile_hits
+        # snapshot first: the streaming forwarder thread may null this
+        # out (stage.end) between a truthiness check and the copy
+        progress = self.progress
+        if progress:
+            d["progress"] = dict(progress)
+        if self.stalled and self.state == RUNNING:
+            d["stalled"] = True
+            if self.last_progress is not None:
+                d["stalled_for_s"] = round(now - self.last_progress, 1)
         return d
 
 

@@ -14,6 +14,7 @@ Requests::
      "follow": true, "after": ["j0001"]}
     {"op": "jobs"}            {"op": "cancel", "job": "..."}
     {"op": "shutdown", "drain": true}        {"op": "ping"}
+    {"op": "status"}          {"op": "trace-dump", "out": "path.json"}
 """
 
 from __future__ import annotations

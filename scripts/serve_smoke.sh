@@ -18,6 +18,18 @@ export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 # run from the repo so the package imports; every path below is absolute
 bst () { (cd "$REPO" && $PYTHON -m bigstitcher_spark_tpu.cli.main "$@"); }
 
+# live-exporter probe (python, not curl — curl is not on every CI host):
+# prints the body, exits non-zero on a non-200 status
+fetch () { $PYTHON -c '
+import sys, urllib.request
+with urllib.request.urlopen(sys.argv[1], timeout=10) as r:
+    sys.stdout.write(r.read().decode())
+' "$1"; }
+
+# a free TCP port for the daemon's HTTP exporter
+PORT=$($PYTHON -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()')
+export BST_METRICS_PORT="$PORT"
+
 echo '[smoke] building tiny fixture ...'
 (cd "$REPO" && $PYTHON - "$WORK" <<'EOF'
 import sys
@@ -40,6 +52,18 @@ echo '[smoke] submitting fusion ...'
 
 echo '[smoke] job table:'
 (bst jobs --socket "$SOCK")
+
+echo '[smoke] live exporter ...'
+# /healthz must answer 200 with ok:true on a healthy draining-free daemon
+fetch "http://127.0.0.1:$PORT/healthz" | grep -q '"ok": true'
+# /metrics must expose a declared bst_serve_* series with live values
+fetch "http://127.0.0.1:$PORT/metrics" | grep -q '^bst_serve_jobs_submitted_total 2'
+fetch "http://127.0.0.1:$PORT/metrics" | grep -q '^bst_process_uptime_seconds'
+echo '[smoke] live view:'
+(bst top --once --socket "$SOCK")
+echo '[smoke] trace dump:'
+(bst trace-dump --socket "$SOCK" --out "$WORK/live-trace.json")
+test -s "$WORK/live-trace.json"
 
 echo '[smoke] draining ...'
 (bst serve --stop --socket "$SOCK")

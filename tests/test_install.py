@@ -33,7 +33,9 @@ def test_installer_covers_every_cli_tool(installed_bin):
                "trace-report": "bst-trace-report",
                "serve": "bst-serve", "submit": "bst-submit",
                "jobs": "bst-jobs", "cancel": "bst-cancel",
-               "pipeline": "bst-pipeline"}
+               "pipeline": "bst-pipeline",
+               "top": "bst-top", "trace-dump": "bst-trace-dump",
+               "history": "bst-history", "perf-diff": "bst-perf-diff"}
     expected = {renamed.get(t, t) for t in set(cli.commands)}
     missing = expected - wrappers
     assert not missing, f"installer missing wrappers for: {sorted(missing)}"
@@ -63,3 +65,13 @@ def test_pipeline_wrapper(installed_bin):
     w = installed_bin / "bst-pipeline"
     assert os.access(w, os.X_OK)
     assert re.search(r"cli\.main pipeline", w.read_text())
+
+
+def test_live_observe_wrappers(installed_bin):
+    for name, tool in (("bst-top", "top"),
+                       ("bst-trace-dump", "trace-dump"),
+                       ("bst-history", "history"),
+                       ("bst-perf-diff", "perf-diff")):
+        w = installed_bin / name
+        assert os.access(w, os.X_OK), name
+        assert re.search(rf"cli\.main {tool}", w.read_text()), name

@@ -88,6 +88,33 @@ class TestMetricsRegistry:
         assert delta["bytes_total"] == 42
         assert delta["level"] == 7  # gauges report current value
 
+    def test_snapshot_delta_histogram_series(self):
+        """Dict-valued Histogram series (the PR 11 wait histogram) must
+        diff per-field — count and sum each baseline-subtracted, never
+        the raw current dict and never a numeric subtraction crash."""
+        reg = metrics.MetricsRegistry()
+        h = reg.histogram("wait_seconds")
+        h.observe(0.5)
+        h.observe(2.0)
+        base = reg.snapshot()
+        h.observe(10.0)
+        delta = reg.snapshot_delta(base)
+        assert delta["wait_seconds"] == {"count": 1, "sum": 10.0}
+        # a histogram series born AFTER the baseline counts from zero
+        h2 = reg.histogram("wait_seconds", kind="new")
+        h2.observe(1.0)
+        delta = reg.snapshot_delta(base)
+        assert delta['wait_seconds{kind="new"}'] == {"count": 1,
+                                                     "sum": 1.0}
+        # an idle histogram deltas to an explicit zero, not a stale total
+        assert reg.snapshot_delta(reg.snapshot())["wait_seconds"] == {
+            "count": 0, "sum": 0.0}
+        # labeled siblings diff independently
+        h.observe(3.0)
+        delta = reg.snapshot_delta(base)
+        assert delta["wait_seconds"] == {"count": 2, "sum": 13.0}
+        assert delta['wait_seconds{kind="new"}']["count"] == 1
+
     def test_prometheus_textfile_format(self):
         reg = metrics.MetricsRegistry()
         reg.counter("bst_io_read_bytes_total", path="native").inc(4096)
