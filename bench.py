@@ -1158,6 +1158,112 @@ def measure_pipeline(xml_path):
     }
 
 
+def measure_solver(xml_path):
+    """numpy vs device vs sharded global-solve wall time at growing
+    synthetic tile grids (ROADMAP item 4: the last driver-side O(tiles)
+    stage moved onto the mesh).
+
+    Builds truth-consistent 8-corner stitching-style link graphs (no
+    image IO — the solver's cost is the iteration, not the matches),
+    then times `models.solver.relax` per backend: the host numpy
+    reference, the jit-compiled device while_loop, and the psum-sharded
+    layout forced on via BST_SOLVE_SHARD=1. AFFINE+RIGID regularization
+    with damping 0.7 keeps the sweep count meaningfully >1 so the
+    per-iteration cost dominates the compile-amortized call. Reported:
+    per-grid seconds + sweep rates, the device/numpy speedup at the
+    largest grid (the acceptance bar: >=1x on the CPU fallback), and the
+    io/solve counter deltas."""
+    import numpy as _np
+
+    from bigstitcher_spark_tpu import config as _c
+    from bigstitcher_spark_tpu.io.spimdata import ViewId
+    from bigstitcher_spark_tpu.models import solver as S
+    from bigstitcher_spark_tpu.ops import models as M
+
+    def graph(n):
+        rng = _np.random.default_rng(17)
+        tiles = [(ViewId(0, i),) for i in range(n[0] * n[1])]
+        truth = {i: _np.array([(i % n[0]) * 80.0, (i // n[0]) * 80.0, 0.0])
+                 for i in range(len(tiles))}
+        nom = {i: truth[i] + (rng.uniform(-3, 3, 3) if i else 0.0)
+               for i in truth}
+        corners = _np.array([[x, y, z] for x in (0, 100) for y in (0, 100)
+                             for z in (0, 50)], float)
+        links = []
+        for i in range(len(tiles)):
+            for j in (i + 1, i + n[0]):
+                if j >= len(tiles):
+                    continue
+                if j == i + 1 and (i % n[0]) == n[0] - 1:
+                    continue
+                shift = (truth[i] - nom[i]) - (truth[j] - nom[j])
+                # per-corner noise keeps the fixed point away from the
+                # warm start so the solve genuinely iterates
+                noise = rng.normal(0, 0.5, corners.shape)
+                links.append(S.MatchLink(
+                    tiles[i], tiles[j], corners, corners + shift + noise,
+                    _np.full(8, 0.9)))
+        return tiles, links
+
+    import jax as _jax
+
+    n_dev = len(_jax.local_devices())
+    iob = _io_baseline()
+    grids = []
+    speedup = 0.0
+    for n in ((12, 12), (24, 24)):
+        tiles, links = graph(n)
+        fixed = {tiles[0]}
+        row = {"tiles": len(tiles), "links": len(links),
+               "local_devices": n_dev}
+        legs = [("numpy", "numpy", None),
+                ("device", "device", {"BST_SOLVE_SHARD": 0})]
+        if n_dev > 1:
+            legs.append(("sharded", "device", {"BST_SOLVE_SHARD": 1}))
+        else:
+            # one local device: BST_SOLVE_SHARD=1 would silently run the
+            # unsharded kernel — report the absence instead of a fake row
+            row["sharded_skipped"] = "1 local device (shard_map not taken)"
+        for label, backend, overrides in legs:
+            params = S.SolverParams(model=M.AFFINE, regularization=M.RIGID,
+                                    damping=0.7, backend=backend)
+            import contextlib
+
+            scope = (_c.overrides(overrides) if overrides
+                     else contextlib.nullcontext())
+            with scope:
+                S.relax(links, tiles, fixed, params)  # warm/compile
+                best = float("inf")
+                iters = 0
+                for _ in range(3):
+                    t0 = time.time()
+                    res = S.relax(links, tiles, fixed, params)
+                    best = min(best, time.time() - t0)
+                    iters = res.iterations
+            row[f"{label}_s"] = round(best, 4)
+            row[f"{label}_sweeps_per_s"] = round(iters / max(best, 1e-9), 1)
+            row[f"{label}_iterations"] = iters
+        row["device_speedup_vs_numpy"] = round(
+            row["numpy_s"] / max(row["device_s"], 1e-9), 2)
+        if "sharded_s" in row:
+            row["sharded_speedup_vs_numpy"] = round(
+                row["numpy_s"] / max(row["sharded_s"], 1e-9), 2)
+        speedup = row["device_speedup_vs_numpy"]
+        grids.append(row)
+    return {
+        "metric": "solver_device_speedup_vs_numpy",
+        "value": speedup,
+        "unit": "x",
+        "note": ("best-of-3 relax() wall per backend on synthetic "
+                 "tile-grid link graphs; device = one compiled "
+                 "lax.while_loop, sharded = psum collective layout "
+                 "forced via BST_SOLVE_SHARD=1; speedup at the largest "
+                 "grid"),
+        "grids": grids,
+        "io": _io_snapshot(iob),
+    }
+
+
 def measure_submit_latency(xml_path):
     """Cold first-submit vs warm repeat-submit wall time through a `bst
     serve` daemon (in-process, one slot): the same affine-fusion job
@@ -1764,6 +1870,7 @@ EXTRA_MEASURES = (
     ("kernel", lambda xml: measure_kernel_only(xml)),
     ("fusion_pyramid", lambda xml: measure_fusion_pyramid(xml)),
     ("pipeline", lambda xml: measure_pipeline(xml)),
+    ("solver", lambda xml: measure_solver(xml)),
     ("submit_latency", lambda xml: measure_submit_latency(xml)),
     ("phasecorr", lambda xml: measure_phasecorr(xml)),
     ("phasecorr_kernel", lambda xml: measure_phasecorr_kernel(xml)),

@@ -111,14 +111,24 @@ def run_cmd(spec, workdir, keep, as_json, summary_path, dry_run):
                    "(written next to the project XML)")
 @click.option("--force", is_flag=True, default=False,
               help="overwrite an existing spec file")
-def init_cmd(out, xml, prefix, force):
+@click.option("--registration", "registration", is_flag=True, default=False,
+              help="write the registration-round spec instead (detect -> "
+                   "match -> solve, the solver barrier-gated on the "
+                   "matcher's correspondences)")
+@click.option("--label", default="beads",
+              help="interest-point label the registration spec uses")
+def init_cmd(out, xml, prefix, force, registration, label):
     """Write a runnable example spec (streamed resave -> fuse ->
-    downsample -> detect) for the project XML to OUT."""
-    from ..dag import PipelineSpec, example_spec
+    downsample -> detect; with --registration the detect -> match ->
+    solve round) for the project XML to OUT."""
+    from ..dag import PipelineSpec, example_spec, registration_spec
 
     if os.path.exists(out) and not force:
         raise click.ClickException(f"{out} exists (use --force)")
-    d = example_spec(xml, prefix=prefix)
+    if registration:
+        d = registration_spec(xml, prefix=prefix, label=label)
+    else:
+        d = example_spec(xml, prefix=prefix)
     PipelineSpec.from_dict(d)   # never emit a spec that does not validate
     with open(out, "w", encoding="utf-8") as f:
         _json.dump(d, f, indent=1)

@@ -52,11 +52,17 @@ from .common import (
 @click.option("--groupChannels/--no-groupChannels", "group_channels", default=None)
 @click.option("--groupTiles", "group_tiles", is_flag=True)
 @click.option("--splitTimepoints", "split_timepoints", is_flag=True)
+@click.option("--solverBackend", "backend", default="auto",
+              type=click.Choice(["auto", "device", "numpy"]),
+              help="global-optimization backend: device = jit-compiled "
+                   "lax.while_loop relaxation (sharded over local devices "
+                   "above BST_SOLVE_SHARD rows), numpy = host reference "
+                   "path, auto = the BST_SOLVE_DEVICE knob (default on)")
 def solver_cmd(xml, dry_run, source, labels, label_weights, method, model,
                regularization, lam, max_error, max_iterations,
                max_plateau_width, relative_threshold, absolute_threshold,
                disable_fixed_views, fixed_views, group_illums, group_channels,
-               group_tiles, split_timepoints, **kwargs):
+               group_tiles, split_timepoints, backend, **kwargs):
     """Globally optimize per-view transforms from stitching shifts or
     corresponding interest points; writes the result into the XML."""
     sd = load_project(xml)
@@ -80,6 +86,7 @@ def solver_cmd(xml, dry_run, source, labels, label_weights, method, model,
         group_channels=group_channels,
         group_tiles=group_tiles,
         split_timepoints=split_timepoints,
+        backend=None if backend == "auto" else backend,
     )
     result = S.solve(sd, views, params)
     for key, corr in sorted(result.corrections.items()):

@@ -129,6 +129,20 @@ _knob("BST_DOG_BLUR", "str", "auto",
       "gemm (Toeplitz matmuls on the MXU); auto picks per backend.",
       choices=("auto", "fft", "gemm"))
 
+# -- global solvers (ops/solve.py) -----------------------------------------
+_knob("BST_SOLVE_DEVICE", "bool", True,
+      "Run the global registration relaxation and the intensity "
+      "coefficient solve as jit-compiled device iteration (one "
+      "lax.while_loop per solve, float64); 0 restores the host numpy "
+      "reference path. Both paths share convergence semantics and agree "
+      "to ≤1e-6 (documented in tests/test_solve_device.py).")
+_knob("BST_SOLVE_SHARD", "int", 500000,
+      "Point-row threshold above which a device solve shards its link "
+      "rows across all local devices (rows grouped by owner tile via "
+      "pairsched cost-weighted placement, per-sweep segment moments "
+      "reduced with psum over the 1-D solve mesh axis). Sharded and "
+      "single-device solves are bit-identical. 0 disables sharding.")
+
 # -- multi-host runtime ----------------------------------------------------
 _knob("BST_COORDINATOR", "str", None,
       "host:port of process 0 for jax.distributed multi-host init "

@@ -16,7 +16,7 @@ between resave, fusion, downsampling and detection.
 """
 
 from .executor import PipelineResult, run_pipeline
-from .spec import PipelineSpec, SpecError, example_spec
+from .spec import PipelineSpec, SpecError, example_spec, registration_spec
 
 __all__ = ["PipelineResult", "PipelineSpec", "SpecError", "example_spec",
-           "run_pipeline"]
+           "registration_spec", "run_pipeline"]

@@ -267,6 +267,42 @@ class PipelineSpec:
             s.args = [sub(a) for a in s.args]
 
 
+def registration_spec(xml: str, prefix: str = "registration",
+                      label: str = "beads") -> dict:
+    """The canonical REGISTRATION round as one streamed pipeline: detect →
+    match → solve (ROADMAP item 2 follow-on, past detect into the
+    interest-point match and the global solve).
+
+    These stages exchange state through the project XML + interest-point
+    store rather than container datasets, so the edges are explicit
+    ``after`` barriers: the matcher starts when detection has committed
+    its points, the solver is barrier-gated on the matcher's
+    correspondences. Run as one ``bst pipeline`` job the three stages
+    share the warm mesh, compiled-fn buckets and decoded-chunk cache —
+    and through ``bst submit --pipeline`` they ride a resident daemon."""
+    xml = os.path.abspath(xml)
+    return {
+        "name": f"{prefix}-detect-match-solve",
+        "datasets": {},
+        "stages": [
+            {"id": "detect", "tool": "detect-interestpoints",
+             "args": ["-x", xml, "-l", label,
+                      "-dsxy", "1", "-dsz", "1"]},
+            {"id": "match", "tool": "match-interestpoints",
+             "args": ["-x", xml, "-l", label, "--clearCorrespondences"],
+             "after": ["detect"]},
+            # the global solve is barrier-gated on the matcher's stored
+            # correspondences; it writes the optimized registrations back
+            # into the project XML
+            {"id": "solve", "tool": "solver",
+             "args": ["-x", xml, "-s", "IP", "-l", label,
+                      "--method", "ONE_ROUND_ITERATIVE",
+                      "-tm", "TRANSLATION"],
+             "after": ["match"]},
+        ],
+    }
+
+
 def example_spec(xml: str, prefix: str = "pipeline") -> dict:
     """The canonical streamed resave -> fuse -> downsample -> detect
     pipeline for a project XML, as a plain spec dict (what ``bst pipeline

@@ -344,6 +344,29 @@ class IntensityStore:
         return np.moveaxis(arr, 0, -1)
 
 
+def smoothness_pairs(dims: tuple[int, int, int], n_views: int) -> np.ndarray:
+    """Intra-view adjacent-cell pairs for every view's coefficient grid,
+    as a (P, 2) array of GLOBAL flat cell indices.
+
+    Pure index arithmetic (one sliced ``arange`` cube per axis broadcast
+    over views) — the former per-view cx/cy/cz/axis quadruple Python loop
+    walked every cell of every view and dominated ``solve_intensities``
+    setup at large grids. Same pair set, axis-major order."""
+    ncell = int(np.prod(dims))
+    idx = np.arange(ncell).reshape(dims)
+    per_axis = []
+    for d in range(3):
+        lo = [slice(None)] * 3
+        hi = [slice(None)] * 3
+        lo[d] = slice(0, dims[d] - 1)
+        hi[d] = slice(1, dims[d])
+        per_axis.append(np.stack(
+            [idx[tuple(lo)].ravel(), idx[tuple(hi)].ravel()], axis=1))
+    base = np.concatenate(per_axis, axis=0)
+    offs = (np.arange(n_views) * ncell)[:, None, None]
+    return (base[None, :, :] + offs).reshape(-1, 2)
+
+
 def solve_intensities(
     matches: list[CellMatch],
     views: list[ViewId],
@@ -376,17 +399,7 @@ def solve_intensities(
                      sxx * s * s, syy * s * s, sxy * s * s))
     # intra-view smoothness: 6-neighborhood of each cell grid, propagating
     # corrections into cells without overlap matches
-    smooth = []
-    strides = (dims[1] * dims[2], dims[2], 1)
-    for vi in range(len(views)):
-        b = vi * ncell
-        for cx in range(dims[0]):
-            for cy in range(dims[1]):
-                for cz in range(dims[2]):
-                    c = (cx * dims[1] + cy) * dims[2] + cz
-                    for d, n_d in enumerate(dims):
-                        if (c // strides[d]) % n_d + 1 < n_d:
-                            smooth.append((b + c, b + c + strides[d]))
+    smooth = smoothness_pairs(dims, len(views))
     sol = solve_intensity_coefficients(ncell * len(views), norm, lam,
                                        smooth_pairs=smooth)
     # un-normalize: f(i) = a*(i*s)/s + b/s... scale invariant: offsets scale

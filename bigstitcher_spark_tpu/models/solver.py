@@ -28,9 +28,10 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from .. import observe
+from .. import observe, profiling
 from ..io.interestpoints import InterestPointStore
 from ..io.spimdata import SpimData, ViewId, ViewTransform, registration_hash
+from ..observe import metrics as _metrics
 from ..ops import models as M
 from ..utils.geometry import (
     Interval,
@@ -39,6 +40,9 @@ from ..utils.geometry import (
 )
 
 Key = tuple  # canonical tile key: sorted tuple of member ViewIds
+
+_SOLVE_ITERS = _metrics.counter("bst_solve_iterations_total")
+_SOLVE_DROPPED = _metrics.counter("bst_solve_links_dropped_total")
 
 
 @dataclass
@@ -56,6 +60,7 @@ class SolverParams:
     relative_threshold: float = 3.5
     absolute_threshold: float = 7.0
     damping: float = 1.0                   # Jacobi under-relaxation factor
+    backend: str | None = None             # device | numpy | None (knob)
     fixed_views: list[ViewId] = field(default_factory=list)
     disable_fixed_views: bool = False
     labels: list[str] = field(default_factory=list)
@@ -90,6 +95,7 @@ class SolveResult:
     iterations: int
     removed_links: list[tuple[Key, Key]]
     link_errors: dict[tuple[Key, Key], float]
+    history: np.ndarray | None = None   # per-iteration mean error
 
 
 # ---------------------------------------------------------------------------
@@ -319,7 +325,31 @@ def _fit_from_moments(kind: str, sw, swp, swq, spp, spq, eps=1e-9):
     raise ValueError(kind)
 
 
+def _resolve_backend(params: SolverParams) -> str:
+    """``device`` (jit lax.while_loop relaxation, the default) or
+    ``numpy`` (the host reference path): explicit params.backend wins,
+    else the BST_SOLVE_DEVICE knob (policy owned by ops.solve)."""
+    from ..ops import solve as _dsolve
+
+    return _dsolve.resolve_backend(params.backend)
+
+
 def relax(
+    links: list[MatchLink],
+    tiles: list[Key],
+    fixed: set[Key],
+    params: SolverParams,
+) -> SolveResult:
+    """One global relaxation: device backend (default) compiles the whole
+    Jacobi iteration into one ``lax.while_loop`` (ops/solve.py), the numpy
+    backend is the host reference both share their convergence semantics
+    with."""
+    if _resolve_backend(params) == "device" and links:
+        return _DeviceRelax(links, tiles, fixed, params).solve()
+    return _relax_numpy(links, tiles, fixed, params)
+
+
+def _relax_numpy(
     links: list[MatchLink],
     tiles: list[Key],
     fixed: set[Key],
@@ -372,33 +402,60 @@ def relax(
                 break
     err = history[-1] if history else 0.0
     link_errors = _per_link_errors(cur, links, index)
+    _SOLVE_ITERS.inc(it)
     return SolveResult(
-        {k: cur[i].copy() for k, i in index.items()}, err, it, [], link_errors
+        {k: cur[i].copy() for k, i in index.items()}, err, it, [],
+        link_errors, history=np.asarray(history),
     )
 
 
 def _direct_translations(links, index, fixed_idx, T) -> np.ndarray:
     """Closed-form weighted least squares over link mean shifts (graph
-    Laplacian); fixed tiles pinned at zero."""
-    A = np.zeros((T, T))
-    B = np.zeros((T, 3))
-    for lk in links:
-        ia, ib = index[lk.key_a], index[lk.key_b]
-        wsum = float(lk.w.sum())
-        s = ((lk.q - lk.p) * lk.w[:, None]).sum(0) / max(wsum, 1e-12)
-        A[ia, ia] += wsum; A[ib, ib] += wsum
-        A[ia, ib] -= wsum; A[ib, ia] -= wsum
-        B[ia] += wsum * s; B[ib] -= wsum * s
+    Laplacian); fixed tiles pinned at zero.
+
+    Assembled SPARSELY from the link incidence (4 entries per link + the
+    anchor/isolated diagonal) and solved with a sparse LU: a tile graph
+    has O(T) links, so the former dense (T, T) build allocated O(T²)
+    purely for structure — at million-tile grids that is the warm start
+    OOMing before the solve even starts."""
+    import scipy.sparse as sp
+    from scipy.sparse.linalg import splu
+
+    if not links:
+        return np.zeros((T, 3))
+    ia = np.fromiter((index[lk.key_a] for lk in links), int, len(links))
+    ib = np.fromiter((index[lk.key_b] for lk in links), int, len(links))
+    wsum = np.array([float(lk.w.sum()) for lk in links])
+    s = np.stack([((lk.q - lk.p) * lk.w[:, None]).sum(0)
+                  / max(float(lk.w.sum()), 1e-12) for lk in links])
     anchor = fixed_idx if len(fixed_idx) else np.arange(1)
-    A[anchor, :] = 0.0
-    A[anchor, anchor] = 1.0
-    B[anchor] = 0.0
-    # isolated tiles (zero diagonal) stay at zero
-    iso = np.diag(A) == 0
-    A[iso, iso] = 1.0
+    anchored = np.zeros(T, bool)
+    anchored[anchor] = True
+    B = np.zeros((T, 3))
+    np.add.at(B, ia, wsum[:, None] * s)
+    np.add.at(B, ib, -wsum[:, None] * s)
+    B[anchored] = 0.0
+    # Laplacian entries, with anchored ROWS replaced by identity rows
+    # (the same pinning the dense build applied destructively)
+    rows = np.concatenate([ia, ib, ia, ib])
+    cols = np.concatenate([ia, ib, ib, ia])
+    vals = np.concatenate([wsum, wsum, -wsum, -wsum])
+    keep = ~anchored[rows]
+    rows, cols, vals = rows[keep], cols[keep], vals[keep]
+    diag = np.zeros(T)
+    np.add.at(diag, ia[~anchored[ia]], wsum[~anchored[ia]])
+    np.add.at(diag, ib[~anchored[ib]], wsum[~anchored[ib]])
+    # anchors and isolated tiles (zero diagonal) get a bare 1.0 diagonal
+    unit = anchored | (diag == 0)
+    off = vals != 0
+    A = sp.coo_matrix(
+        (np.concatenate([vals[off], np.ones(int(unit.sum()))]),
+         (np.concatenate([rows[off], np.flatnonzero(unit)]),
+          np.concatenate([cols[off], np.flatnonzero(unit)]))),
+        shape=(T, T)).tocsc()
     try:
-        return np.linalg.solve(A, B)
-    except np.linalg.LinAlgError:
+        return splu(A).solve(B)
+    except RuntimeError:
         return np.zeros((T, 3))
 
 
@@ -420,17 +477,148 @@ def _per_link_errors(models, links, index) -> dict[tuple[Key, Key], float]:
     return out
 
 
+class _DeviceRelax:
+    """Driver for the compiled relaxation (ops/solve.py): flattens the
+    link graph ONCE into padded device arrays, then every solve — the
+    first and every masked re-solve of the iterative drop-worst-link loop
+    — re-enters the same compiled ``lax.while_loop`` with a per-link
+    weight mask. Above ``BST_SOLVE_SHARD`` point rows the arrays are laid
+    out per local device (tiles placed cost-weighted via
+    ``pairsched.assign_tasks``) and each sweep's segment moments reduce
+    with ``lax.psum`` over the 1-D solve mesh axis."""
+
+    def __init__(self, links: list[MatchLink], tiles: list[Key],
+                 fixed: set[Key], params: SolverParams):
+        from ..ops import solve as _dsolve
+
+        self.links = list(links)
+        self.tiles = tiles
+        self.params = params
+        self.index = {k: i for i, k in enumerate(tiles)}
+        self.fixed_idx = np.array(
+            sorted(self.index[k] for k in fixed if k in self.index), int)
+        T = len(tiles)
+        rows = [(self.index[lk.key_a], self.index[lk.key_b],
+                 np.asarray(lk.p, np.float64), np.asarray(lk.q, np.float64),
+                 np.asarray(lk.w, np.float64)) for lk in self.links]
+        n_rows = 2 * sum(len(lk.p) for lk in self.links)
+        n_shards = _dsolve.shard_count(n_rows)
+        # bst-lint: off=host-sync (shard_count returns a host int)
+        if n_shards > 1:
+            from ..parallel.pairsched import PairTask, assign_tasks
+
+            # rows per tile drive placement: the per-device row counts are
+            # the actual load of the sharded segment-moment pass
+            per_tile = np.zeros(T)
+            for ia, ib, p, _, _ in rows:
+                per_tile[ia] += len(p)
+                per_tile[ib] += len(p)
+            bins = assign_tasks(
+                [PairTask(index=t, cost=float(per_tile[t]))
+                 for t in range(T)], n_shards)
+            tile_shard = np.zeros(T, np.int32)
+            for d, bin_tasks in enumerate(bins):
+                for t in bin_tasks:
+                    tile_shard[t.index] = d
+            self.problem = _dsolve.prepare_relax(rows, T, n_shards,
+                                                 tile_shard)
+        else:
+            self.problem = _dsolve.prepare_relax(rows, T, 1)
+        self.fixed_mask = np.zeros(T, bool)
+        if len(self.fixed_idx):
+            self.fixed_mask[self.fixed_idx] = True
+
+    def solve(self, link_mask: np.ndarray | None = None) -> SolveResult:
+        import time
+
+        import jax
+
+        from ..ops import solve as _dsolve
+
+        p = self.params
+        T = len(self.tiles)
+        identity = np.zeros((3, 4))
+        identity[:, :3] = np.eye(3)
+        if link_mask is None:
+            link_mask = np.ones(len(self.links))
+        active = [lk for lk, m in zip(self.links, link_mask) if m]
+        if not active:
+            return SolveResult({k: identity.copy() for k in self.tiles},
+                               0.0, 0, [], {}, history=np.zeros(0))
+        # warm start on the ACTIVE links only, so a masked re-solve equals
+        # a rebuilt-link-list solve exactly
+        warm_t = _direct_translations(active, self.index, self.fixed_idx, T)
+        reg = p.regularization if (p.regularization != M.NONE
+                                   and p.lam > 0) else M.NONE
+        # build + XLA-compile OUTSIDE the timed span: the device-ms
+        # counter measures the compiled loop, never a cold bucket's build
+        _dsolve.ensure_relax_compiled(self.problem, p.model, reg,
+                                      p.max_iterations,
+                                      p.max_plateau_width)
+        t0 = time.perf_counter()
+        with profiling.span("solve.relax", stage="solver",
+                            item=self.problem.n_rows):
+            out = _dsolve.relax_on_device(
+                self.problem, link_mask, self.fixed_mask, warm_t,
+                p.lam, p.damping, p.max_error, p.max_iterations,
+                p.model, reg, p.max_plateau_width)
+        _metrics.counter("bst_solve_device_ms_total", stage="relax").inc(
+            (time.perf_counter() - t0) * 1000.0)
+        with profiling.span("solve.reduce", stage="solver"):
+            models, hist, iters, link_err = jax.device_get(out)
+        iters = int(iters)
+        history = hist[:iters]
+        err = float(history[-1]) if iters else 0.0
+        link_errors = {
+            (lk.key_a, lk.key_b): float(link_err[l])
+            for l, lk in enumerate(self.links) if link_mask[l]
+        }
+        _SOLVE_ITERS.inc(iters)
+        return SolveResult(
+            {k: models[i].copy() for k, i in self.index.items()},
+            err, iters, [], link_errors, history=history,
+        )
+
+
 def solve_iterative(
     links: list[MatchLink], tiles: list[Key], fixed: set[Key], params: SolverParams,
     verbose: bool = True,
 ) -> SolveResult:
     """GlobalOptIterative: re-solve dropping the worst link while it exceeds
     max(relThresh × avg, absThresh) (Solver.java:310-318; defaults
-    relative 3.5 / absolute 7.0, Solver.java:131-134)."""
+    relative 3.5 / absolute 7.0, Solver.java:131-134).
+
+    On the device backend the link list is flattened/compiled ONCE and
+    every re-solve re-enters the warm compiled fn with a zeroed entry in
+    the link-weight mask — no per-drop re-trace, no array rebuild."""
     links = list(links)
-    removed: list[tuple[Key, Key]] = []
+    if _resolve_backend(params) == "device" and links:
+        state = _DeviceRelax(links, tiles, fixed, params)
+        key_to_l = {(lk.key_a, lk.key_b): l for l, lk in enumerate(links)}
+        mask = np.ones(len(links))
+        removed: list[tuple[Key, Key]] = []
+        while True:
+            res = state.solve(mask)
+            if not res.link_errors or int(mask.sum()) <= 1:
+                break
+            avg = float(np.mean(list(res.link_errors.values())))
+            worst_key = max(res.link_errors, key=res.link_errors.get)
+            worst = res.link_errors[worst_key]
+            if not (worst > params.relative_threshold * avg
+                    and worst > params.absolute_threshold):
+                break
+            observe.log(f"solver: dropping link {worst_key[0][0]}<->"
+                        f"{worst_key[1][0]} error {worst:.2f} "
+                        f"(avg {avg:.2f})", stage="solver", echo=verbose,
+                        error=round(float(worst), 3))
+            mask[key_to_l[worst_key]] = 0.0
+            removed.append(worst_key)
+        res.removed_links.extend(removed)
+        _SOLVE_DROPPED.inc(len(removed))
+        return res
+    removed = []
     while True:
-        res = relax(links, tiles, fixed, params)
+        res = _relax_numpy(links, tiles, fixed, params)
         if not res.link_errors or len(links) <= 1:
             break
         avg = float(np.mean(list(res.link_errors.values())))
@@ -448,6 +636,7 @@ def solve_iterative(
         links = [lk for lk in links if (lk.key_a, lk.key_b) != worst_key]
         removed.append(worst_key)
     res.removed_links.extend(removed)
+    _SOLVE_DROPPED.inc(len(removed))
     return res
 
 

@@ -104,6 +104,16 @@ METRICS: dict[str, str] = {
     "bst_serve_jobs_stalled":
         "RUNNING jobs whose stage.progress has not advanced for "
         "BST_STALL_TIMEOUT_S (the stall watchdog's live gauge)",
+    # device-side global solvers (ops/solve.py, models/solver.py,
+    # ops/intensity.py): the compiled-relaxation / CG hot path
+    "bst_solve_iterations_total":
+        "relaxation sweeps (or CG steps) executed inside compiled device "
+        "solve loops, labeled by stage where applicable",
+    "bst_solve_links_dropped_total":
+        "links removed by the iterative drop-worst-link solve",
+    "bst_solve_device_ms_total":
+        "wall milliseconds spent inside compiled device solve kernels, "
+        "labeled by stage (relax / intensity)",
     # streaming stage-DAG executor (dag/): producer->consumer block
     # exchange that replaces intermediate-container round-trips
     "bst_dag_blocks_streamed_total":
@@ -188,6 +198,13 @@ SPANS: dict[str, str] = {
         "the watchdog flagged a running job as stalled (instant)",
     "serve.trace_dump":
         "the live flight-recorder ring was snapshotted on demand (instant)",
+    # device-side global solvers (models/solver.py, ops/intensity.py)
+    "solve.relax":
+        "one compiled global-solve kernel invocation (the whole "
+        "lax.while_loop relaxation or CG iteration, dispatch to done)",
+    "solve.reduce":
+        "host fetch of a device solve's final models/errors (the single "
+        "drain point of a solve call)",
     # streaming stage-DAG executor (dag/executor.py, dag/stream.py)
     "dag.stage": "one pipeline stage's full execution on its thread",
     "dag.wait":

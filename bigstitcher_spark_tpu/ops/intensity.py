@@ -19,10 +19,14 @@ tiny: 2 unknowns per cell).
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .. import profiling
+from ..observe import metrics as _metrics
 
 
 # --------------------------------------------------------------------------
@@ -140,6 +144,7 @@ def solve_intensity_coefficients(
     lam: float = 0.1,
     smooth_pairs: list[tuple[int, int]] | None = None,
     smooth_weight: float = 0.5,
+    backend: str | None = None,
 ) -> np.ndarray:
     """Global least squares over the coefficient graph.
 
@@ -154,6 +159,14 @@ def solve_intensity_coefficients(
     propagates corrections into cells that have no overlap matches (weighted
     by the mean data moments so it is scale-free).
     Returns (n_cells, 2) [scale, offset].
+
+    ``backend`` picks the solve: ``"device"`` (default via
+    ``BST_SOLVE_DEVICE``) runs a matrix-free conjugate-gradient iteration
+    over the match rows in one compiled device loop (ops/solve.py) —
+    above ``BST_SOLVE_SHARD`` rows the rows shard across local devices
+    and each CG matvec reduces with psum; ``"numpy"`` assembles the dense
+    (2C, 2C) normal equations and solves directly (the reference path the
+    CG agrees with to ≤1e-6, documented in tests/test_solve_device.py).
     """
     # quadratic form: min Σ_m Σ_k (s_a x_k + o_a - s_b y_k - o_b)^2
     #               + Σ_c lam_c ((s_c-1)^2) + mu_c o_c^2
@@ -161,26 +174,41 @@ def solve_intensity_coefficients(
     # the identity regularizer must be weighted by each cell's own data
     # moments (lam_c = lam * Σ x², mu_c = lam * Σ n) — scale-free, and the
     # gauge collapse toward s=0 is resisted in proportion to the data.
-    A = np.zeros((2 * n_cells, 2 * n_cells))
-    rhs = np.zeros(2 * n_cells)
+    smooth_arr = (np.asarray(smooth_pairs, int).reshape(-1, 2)
+                  if smooth_pairs is not None and len(smooth_pairs)
+                  else np.zeros((0, 2), int))
     cell_xx = np.full(n_cells, 1e-12)
     cell_n = np.full(n_cells, 1e-12)
-    for ca, cb, n, sx, sy, sxx, syy, sxy in matches:
-        cell_xx[ca] += sxx
-        cell_n[ca] += n
-        cell_xx[cb] += syy
-        cell_n[cb] += n
+    rows = (np.asarray(matches, np.float64).reshape(-1, 8) if matches
+            else np.zeros((0, 8)))
+    ca_all = rows[:, 0].astype(int)
+    cb_all = rows[:, 1].astype(int)
+    np.add.at(cell_xx, ca_all, rows[:, 5])
+    np.add.at(cell_n, ca_all, rows[:, 2])
+    np.add.at(cell_xx, cb_all, rows[:, 6])
+    np.add.at(cell_n, cb_all, rows[:, 2])
     idx = np.arange(n_cells)
     lam_eff = max(lam, 1e-6)  # unmatched cells must still solve to identity
-    A[2 * idx, 2 * idx] += lam_eff * np.maximum(cell_xx, 1.0)
-    A[2 * idx + 1, 2 * idx + 1] += lam_eff * np.maximum(cell_n, 1.0)
-    rhs[2 * idx] += lam_eff * np.maximum(cell_xx, 1.0)
-    if smooth_pairs:
+    wxx = wn = 0.0
+    if len(smooth_arr):
         wxx = smooth_weight * max(float(np.mean(cell_xx[cell_xx > 1e-6]))
                                   if (cell_xx > 1e-6).any() else 1.0, 1.0)
         wn = smooth_weight * max(float(np.mean(cell_n[cell_n > 1e-6]))
                                  if (cell_n > 1e-6).any() else 1.0, 1.0)
-        for ci, cj in smooth_pairs:
+    from . import solve as _dsolve
+
+    backend = _dsolve.resolve_backend(backend)
+    if backend == "device" and len(rows):
+        return _solve_coefficients_device(
+            n_cells, rows, lam_eff, cell_xx, cell_n, smooth_arr, wxx, wn)
+
+    A = np.zeros((2 * n_cells, 2 * n_cells))
+    rhs = np.zeros(2 * n_cells)
+    A[2 * idx, 2 * idx] += lam_eff * np.maximum(cell_xx, 1.0)
+    A[2 * idx + 1, 2 * idx + 1] += lam_eff * np.maximum(cell_n, 1.0)
+    rhs[2 * idx] += lam_eff * np.maximum(cell_xx, 1.0)
+    if len(smooth_arr):
+        for ci, cj in smooth_arr:
             for off, w in ((0, wxx), (1, wn)):
                 i, j = 2 * ci + off, 2 * cj + off
                 A[i, i] += w
@@ -211,6 +239,46 @@ def solve_intensity_coefficients(
         A[ib + 1, ib + 1] += n
     sol = np.linalg.solve(A, rhs)
     return sol.reshape(n_cells, 2)
+
+
+def _solve_coefficients_device(n_cells, rows, lam_eff, cell_xx, cell_n,
+                               smooth_arr, wxx, wn) -> np.ndarray:
+    """Device CG path of :func:`solve_intensity_coefficients`: same
+    regularizer/smoothness assembly, matrix-free matvec over the match
+    rows inside one compiled while_loop (sharded + psum-reduced above
+    BST_SOLVE_SHARD rows)."""
+    from . import solve as _dsolve
+
+    diag = np.zeros(2 * n_cells)
+    diag[0::2] = lam_eff * np.maximum(cell_xx, 1.0)
+    diag[1::2] = lam_eff * np.maximum(cell_n, 1.0)
+    rhs = np.zeros(2 * n_cells)
+    rhs[0::2] = diag[0::2]
+    # per-component flattened smoothness pairs: scale rows tie 2c indices
+    # with weight wxx, offset rows 2c+1 with wn
+    if len(smooth_arr):
+        sidx = np.concatenate([2 * smooth_arr, 2 * smooth_arr + 1])
+        sw = np.concatenate([np.full(len(smooth_arr), wxx),
+                             np.full(len(smooth_arr), wn)])
+    else:
+        sidx = np.zeros((0, 2), int)
+        sw = np.zeros(0)
+    n_shards = _dsolve.shard_count(len(rows))
+    # build + XLA-compile outside the timed span (cold-bucket builds must
+    # not pollute the device-ms counter); the bucket record derives from
+    # the SAME shape math the factory key uses
+    _dsolve.ensure_cg_compiled(n_cells, len(rows), len(sidx), n_shards)
+    t0 = time.perf_counter()
+    with profiling.span("solve.relax", stage="intensity", item=len(rows)):
+        out = _dsolve.solve_intensity_device(
+            n_cells, rows, diag, rhs, sidx, sw, n_shards)
+    _metrics.counter("bst_solve_device_ms_total", stage="intensity").inc(
+        (time.perf_counter() - t0) * 1000.0)
+    with profiling.span("solve.reduce", stage="intensity"):
+        sol, iters = jax.device_get(out)
+    _metrics.counter("bst_solve_iterations_total", stage="intensity").inc(
+        int(iters))
+    return np.asarray(sol)[: 2 * n_cells].reshape(n_cells, 2)
 
 
 def match_stats(x: np.ndarray, y: np.ndarray) -> tuple[float, ...]:

@@ -101,7 +101,8 @@ _COMPILE_COLD = _metrics.counter("bst_compiled_fn_cold_builds_total")
 # factory call), so eviction here tracks eviction there — an unbounded
 # seen-set would keep reporting "warm" for signatures the bounded
 # lru_cache already dropped and must recompile
-_BUCKET_CAPS = {"sharded": 64, "composite": 32}
+_BUCKET_CAPS = {"sharded": 64, "composite": 32, "solve": 32,
+                "solve_cg": 16}
 _BUCKET_LRU: dict[str, "OrderedDict"] = {}
 _BUCKET_LOCK = threading.Lock()
 
