@@ -41,10 +41,15 @@ _NO_LIVE_EXPORTER = {"serve", "submit", "jobs", "cancel", "top",
 def cli(ctx):
     """TPU-native BigStitcher: distributed stitching & fusion tools."""
     # multi-host bootstrap: no-op unless BST_COORDINATOR/BST_NUM_PROCESSES/
-    # BST_PROCESS_ID (or BST_DISTRIBUTED=1 on an autodetecting pod) are set
+    # BST_PROCESS_ID (or BST_DISTRIBUTED=1 on an autodetecting pod) are
+    # set. The telemetry relay (BST_TELEMETRY_RELAY) rides along for
+    # workload tools only — a short `bst submit`/`bst jobs` has nothing
+    # live to push, and a `bst serve` daemon hosts the collector itself
+    # inside Daemon.start()
     from ..parallel.distributed import init_distributed
 
-    init_distributed()
+    init_distributed(
+        start_relay=ctx.invoked_subcommand not in _NO_LIVE_EXPORTER)
     # live HTTP exporter for long one-shot runs: no-op unless
     # BST_METRICS_PORT is set (the serve daemon wires richer providers in)
     if ctx.invoked_subcommand not in _NO_LIVE_EXPORTER:

@@ -116,37 +116,95 @@ def _render_top(status: dict, jobs: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def _fetch_cluster(socket_path, url) -> dict:
+    """The relay collector's per-rank document, over HTTP (/cluster)
+    when --url, else the daemon socket's `cluster` op."""
+    if url:
+        import urllib.request
+
+        with urllib.request.urlopen(url.rstrip("/") + "/cluster",
+                                    timeout=5) as r:
+            return _json.load(r)
+    from ..serve import client
+
+    return client.cluster_status(socket_path)
+
+
+def _render_cluster(doc: dict) -> str:
+    col = doc.get("collector") or {}
+    lines = [
+        f"relay collector {col.get('address')}  up {col.get('uptime_s')}s"
+        f"  ranks {col.get('ranks')} ({col.get('connected')} connected)"
+        f"  stall timeout {col.get('stall_timeout_s')}s",
+        "",
+        f"{'HOST':<18} {'RANK':>4}  {'STATE':<9} {'AGE':>6} "
+        f"{'PROGRESS':<24} {'CACHE':>6} {'INFLIGHT-HW':>11} {'DROP':>5}",
+    ]
+    for r in doc.get("ranks", []):
+        p = r.get("progress") or {}
+        prog = (f"{p.get('stage', '?')} {p.get('done')}/{p.get('total')}"
+                if p else "-")
+        if p.get("finished"):
+            prog += " done"
+        state = ("done" if r.get("done")
+                 else "STALLED" if r.get("stalled")
+                 else "live" if r.get("connected") else "lost")
+        infl = (r.get("inflight") or {}).get("highwater_bytes")
+        drop = r.get("dropped") or {}
+        dropn = (drop.get("queue", 0) or 0) + (drop.get("conn", 0) or 0)
+        lines.append(
+            f"{r.get('host', '?'):<18} {r.get('process_index', '?'):>4}  "
+            f"{state:<9} {r.get('age_s', '?'):>5}s {prog:<24} "
+            f"{_hit_ratio(r.get('chunk_cache') or {}):>6} "
+            f"{_fmt_bytes(infl):>11} {dropn:>5}")
+    if not doc.get("ranks"):
+        lines.append("(no ranks connected yet — workers push when "
+                     "BST_TELEMETRY_RELAY points here)")
+    return "\n".join(lines)
+
+
 @click.command()
 @_socket_opt
 @click.option("--url", "url", default=None,
               help="poll the daemon's HTTP exporter (/status, /jobs) "
                    "instead of the socket, e.g. http://127.0.0.1:9100")
+@click.option("--cluster", "cluster", is_flag=True, default=False,
+              help="show the relay collector's per-host rank rows "
+                   "(/cluster) instead of the local job table")
 @click.option("--interval", type=float, default=2.0, show_default=True,
               help="refresh period in seconds")
 @click.option("--once", is_flag=True, default=False,
               help="render a single frame and exit (scripts, tests)")
-def top_cmd(socket_path, url, interval, once):
+def top_cmd(socket_path, url, cluster, interval, once):
     """Live terminal view of a `bst serve` daemon.
 
     Shows queue depth and per-share runtime, each job's progress/ETA and
     stall state, cache hit ratios, and the in-flight byte high-water —
-    refreshed every --interval seconds until Ctrl-C."""
+    refreshed every --interval seconds until Ctrl-C. With --cluster,
+    shows the pod view instead: one row per relayed rank (host, heartbeat
+    age, stage progress, stall verdict, cache ratio, in-flight
+    high-water, relay drops)."""
+    def frame() -> str:
+        if cluster:
+            return _render_cluster(_fetch_cluster(socket_path, url))
+        return _render_top(*_fetch(socket_path, url))
+
     try:
-        status, jobs = _fetch(socket_path, url)
+        rendered = frame()
     except (OSError, RuntimeError, ValueError) as e:
         raise click.ClickException(
             f"{e} — is a daemon running? start one with `bst serve`")
     if once:
-        click.echo(_render_top(status, jobs))
+        click.echo(rendered)
         return
     try:
         while True:
             click.echo("\x1b[2J\x1b[H", nl=False)   # clear + home
-            click.echo(_render_top(status, jobs))
+            click.echo(rendered)
             click.echo(f"\n[{time.strftime('%H:%M:%S')}] refresh every "
                        f"{interval}s — Ctrl-C to exit")
             time.sleep(max(0.2, interval))
-            status, jobs = _fetch(socket_path, url)
+            rendered = frame()
     except KeyboardInterrupt:
         pass
     except (OSError, RuntimeError, ValueError) as e:
@@ -158,23 +216,39 @@ def top_cmd(socket_path, url, interval, once):
 @click.option("--out", "out", default=None,
               help="output path for the Perfetto JSON (default: "
                    "trace-dump-<n>.json in the daemon's jobs root)")
-def trace_dump_cmd(socket_path, out):
+@click.option("--cluster", "cluster", is_flag=True, default=False,
+              help="pull every relay-connected rank's live ring too and "
+                   "fold them (barrier-aligned) into the one file")
+def trace_dump_cmd(socket_path, out, cluster):
     """Snapshot the daemon's live flight-recorder ring to Perfetto JSON.
 
     The daemon records its timeline always (bounded ring, newest events
     win); this dumps the current contents WITHOUT pausing jobs or
     stopping the recorder — load the file in ui.perfetto.dev or run
-    `bst trace-report` on it."""
+    `bst trace-report` on it. With --cluster, the daemon's relay
+    collector requests a live ring snapshot from every connected rank
+    over the relay and merges them with its own onto one clock-aligned
+    timeline — the whole pod, mid-run."""
     import os
 
     from ..serve import client
 
     try:
         resp = client.trace_dump(socket_path,
-                                 out=os.path.abspath(out) if out else None)
+                                 out=os.path.abspath(out) if out else None,
+                                 cluster=cluster)
     except (OSError, RuntimeError) as e:
         raise click.ClickException(
             f"{e} — is a daemon running? start one with `bst serve`")
+    if cluster:
+        line = (f"{resp.get('path')} ({resp.get('ranks')}/"
+                f"{resp.get('asked')} rank ring(s)"
+                + (", local ring" if resp.get("local_ring") else "")
+                + f"; analyze with 'bst trace-report')")
+        if resp.get("missing"):
+            line += f"  WARNING: {resp['missing']} rank(s) did not answer"
+        click.echo(line)
+        return
     click.echo(f"{resp.get('path')} ({resp.get('buffered')} events "
                f"buffered, {resp.get('dropped')} dropped; analyze with "
                f"'bst trace-report')")

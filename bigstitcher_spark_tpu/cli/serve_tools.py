@@ -36,6 +36,11 @@ def _socket_opt(f):
               help="port of the live HTTP exporter (/metrics /healthz "
                    "/status /jobs on 127.0.0.1); 0 picks a free port, "
                    "default: BST_METRICS_PORT (whose 0 means off)")
+@click.option("--relay", "relay", default=None, metavar="HOST:PORT",
+              help="host the pod telemetry collector at this address "
+                   "(default: BST_TELEMETRY_RELAY): relayed worker ranks "
+                   "feed the daemon's /metrics, /healthz, /cluster and "
+                   "`bst top --cluster`; port 0 picks a free one")
 @click.option("--detach", is_flag=True, default=False,
               help="start the daemon as a background process and return "
                    "once it answers ping")
@@ -44,7 +49,7 @@ def _socket_opt(f):
 @click.option("--status", is_flag=True, default=False,
               help="ping the daemon and print its status")
 def serve_cmd(socket_path, slots, jobs_root, idle_timeout, metrics_port,
-              detach, stop, status):
+              relay, detach, stop, status):
     """Run (or manage) the persistent stitching daemon.
 
     The daemon owns the device mesh and every process-wide cache
@@ -62,19 +67,29 @@ def serve_cmd(socket_path, slots, jobs_root, idle_timeout, metrics_port,
         click.echo(_json.dumps(client.ping(socket_path), indent=1))
         return
     if detach:
+        from .. import config
+
         pid = daemon.spawn_detached(socket_path, slots=slots,
                                     jobs_root=jobs_root,
                                     idle_timeout=idle_timeout,
-                                    metrics_port=metrics_port)
+                                    metrics_port=metrics_port,
+                                    relay=relay)
         pong = client.ping(socket_path)
         port = pong.get("metrics_port")
+        rly = pong.get("relay")
+        # the child daemon inherits this environment, so the exporter
+        # bound the same BST_METRICS_HOST this process resolves
+        from ..observe.httpexport import display_host
+
+        host = display_host(config.get_str("BST_METRICS_HOST"))
         click.echo(f"serve: daemon ready (pid {pid})"
-                   + (f", live exporter http://127.0.0.1:{port}"
-                      if port else ""))
+                   + (f", live exporter http://{host}:{port}"
+                      if port else "")
+                   + (f", relay collector {rly}" if rly else ""))
         return
     daemon.run_foreground(socket_path, slots=slots, jobs_root=jobs_root,
                           idle_timeout=idle_timeout,
-                          metrics_port=metrics_port)
+                          metrics_port=metrics_port, relay=relay)
 
 
 def _parse_sets(pairs) -> dict:

@@ -93,6 +93,19 @@ def telemetry_merge_cmd(telemetry_dir, output):
         click.echo(f"retry rounds: {int(retries)}")
     click.echo(f"merged report -> {out}")
 
+    # with a history store configured, the merged POD manifest records
+    # there too, so `bst history` / `bst perf-diff` cover pod runs, not
+    # only single-process finalize paths; history IO never fails a merge
+    try:
+        from ..observe.history import record_merged_report
+
+        rid = record_merged_report(report, source=out)
+    except Exception:
+        rid = None
+    if rid:
+        click.echo(f"recorded in history as {rid} "
+                   f"(diff pod runs with 'bst perf-diff')")
+
     # fold any per-process flight-recorder traces onto one barrier-aligned
     # timeline so trace-report / Perfetto see the whole pod run at once
     from ..observe.trace import merge_traces

@@ -176,9 +176,33 @@ _knob("BST_TRACE_PATH", "str", None,
 _knob("BST_METRICS_PORT", "int", 0,
       "TCP port of the embedded live HTTP exporter (observe/httpexport.py: "
       "/metrics Prometheus text, /healthz liveness, /status + /jobs JSON) "
-      "on 127.0.0.1; 0 disables. The `bst serve` daemon and long one-shot "
-      "runs both honor it; `bst serve --metrics-port 0` asks the OS for a "
-      "free port instead.")
+      "on BST_METRICS_HOST; 0 disables. The `bst serve` daemon and long "
+      "one-shot runs both honor it; `bst serve --metrics-port 0` asks the "
+      "OS for a free port instead.")
+_knob("BST_METRICS_HOST", "str", "127.0.0.1",
+      "Bind address of the live HTTP exporter. The default keeps the "
+      "plane host-local; a pod's rank-0 exporter sets 0.0.0.0 (or a "
+      "specific interface) so dashboards can scrape the aggregated view "
+      "from outside the host. The exporter has NO auth — only widen the "
+      "bind on a trusted network (see README 'Live monitoring').")
+_knob("BST_TELEMETRY_RELAY", "str", None,
+      "host:port of the pod telemetry collector (observe/relay.py). When "
+      "set, rank 0 of a multi-process world (and any `bst serve` daemon) "
+      "hosts the collector at that address and every other process pushes "
+      "periodic metric snapshots, health heartbeats and warn/error events "
+      "to it over TCP, so the rank-0 live plane (/metrics /healthz "
+      "/cluster, `bst top --cluster`) covers the whole pod. Unset (the "
+      "default) the relay is fully off: zero overhead, byte-identical "
+      "telemetry.")
+_knob("BST_RELAY_INTERVAL_S", "float", 2.0,
+      "Seconds between a relay push client's metric-snapshot heartbeats. "
+      "Must be comfortably below BST_STALL_TIMEOUT_S, past which a "
+      "silent rank flips the pod /healthz to 503.")
+_knob("BST_RELAY_QUEUE", "int", 256,
+      "Bounded length of the relay client's outbound message queue. A "
+      "slow or absent collector fills it and further messages drop (and "
+      "count in bst_relay_dropped_total) — the producing rank's hot path "
+      "never blocks on telemetry.")
 _knob("BST_HISTORY_DIR", "str", None,
       "Directory of the cross-run manifest history store "
       "(observe/history.py): every finalized run/job manifest appends a "
@@ -387,6 +411,10 @@ def get_bytes(name: str) -> int | None:
 
 
 def get_str(name: str) -> str | None:
+    return get(name)
+
+
+def get_float(name: str) -> float | None:
     return get(name)
 
 

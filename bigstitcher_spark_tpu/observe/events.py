@@ -89,6 +89,24 @@ class _Sink:
 
 _default: _Sink | None = None
 _jobs: dict[str, _Sink] = {}
+
+# global taps see EVERY emitted event regardless of sink state (the
+# telemetry relay forwards a warn/error subset to the pod collector even
+# when no --telemetry-dir is configured). Empty by default: the
+# no-telemetry hot path pays one extra truthiness check.
+_taps: list = []
+
+
+def add_tap(cb) -> None:
+    if cb not in _taps:
+        _taps.append(cb)
+
+
+def remove_tap(cb) -> None:
+    if cb in _taps:
+        _taps.remove(cb)
+
+
 _current: contextvars.ContextVar[str | None] = \
     contextvars.ContextVar("bst-event-job", default=None)
 
@@ -139,26 +157,35 @@ def _json_safe(o):
 
 def emit(etype: str, **fields) -> None:
     """Append one event to the current scope's sink; no-op unless one is
-    configured. ``None`` fields drop. Subscribers run OUTSIDE the module
-    lock (a slow consumer — e.g. a serve client socket — must not stall
-    every other emitter)."""
+    configured or a global tap (the telemetry relay) is listening.
+    ``None`` fields drop. Subscribers run OUTSIDE the module lock (a slow
+    consumer — e.g. a serve client socket — must not stall every other
+    emitter)."""
     s = _sink()
-    if s is None:
+    if s is None and not _taps:
         return
     rec = {"ts": round(time.time(), 6), "type": etype}
     rec.update({k: v for k, v in fields.items() if v is not None})
-    with _lock:
-        if s is not _sink():   # scope closed while we raced here
-            return
-        s.write_locked(rec)
-        subs = list(s.subscribers)
-    for cb in subs:
+    if s is not None:
+        with _lock:
+            if s is not _sink():   # scope closed while we raced here
+                s = None
+            else:
+                s.write_locked(rec)
+                subs = list(s.subscribers)
+        if s is not None:
+            for cb in subs:
+                try:
+                    cb(rec)
+                except Exception:
+                    with _lock:
+                        if cb in s.subscribers:
+                            s.subscribers.remove(cb)
+    for tap in list(_taps):
         try:
-            cb(rec)
+            tap(rec)
         except Exception:
-            with _lock:
-                if cb in s.subscribers:
-                    s.subscribers.remove(cb)
+            pass   # a broken tap must never cost the emitting run
 
 
 def close() -> str | None:

@@ -65,6 +65,28 @@ def _next_record_id(tool: str | None) -> str:
     return f"{(tool or 'run')}-{stamp}-p{os.getpid()}-{n:03d}"
 
 
+def _write_record(d: str, rid: str, rec: dict,
+                  job: str | None = None) -> str:
+    """The shared store-append tail: atomic record file + one-line
+    index.jsonl append (O_APPEND: concurrent processes never tear it)."""
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, rid + ".json")
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(rec, f, indent=1, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+    line = json.dumps({"id": rid, "ts": rec["recorded_at"],
+                       "tool": rec.get("tool"), "job": job,
+                       "status": rec.get("status"),
+                       "seconds": rec.get("seconds"),
+                       "file": os.path.basename(path)})
+    with open(os.path.join(d, "index.jsonl"), "a", encoding="utf-8") as f:
+        f.write(line + "\n")
+    _RECORDS.inc()
+    return rid
+
+
 def record_manifest(manifest_path: str, *, job: str | None = None,
                     directory: str | None = None) -> str | None:
     """Append one finalized manifest to the history store; returns the
@@ -83,22 +105,47 @@ def record_manifest(manifest_path: str, *, job: str | None = None,
     if job is not None:
         rec["job"] = job
     rec.update({k: doc[k] for k in _KEEP if k in doc})
-    os.makedirs(d, exist_ok=True)
-    path = os.path.join(d, rid + ".json")
-    tmp = path + f".tmp{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(rec, f, indent=1, default=str)
-        f.write("\n")
-    os.replace(tmp, path)
-    line = json.dumps({"id": rid, "ts": rec["recorded_at"],
-                       "tool": rec.get("tool"), "job": job,
-                       "status": rec.get("status"),
-                       "seconds": rec.get("seconds"),
-                       "file": os.path.basename(path)})
-    with open(os.path.join(d, "index.jsonl"), "a", encoding="utf-8") as f:
-        f.write(line + "\n")   # one line, O_APPEND: concurrency-safe
-    _RECORDS.inc()
-    return rid
+    return _write_record(d, rid, rec, job=job)
+
+
+def record_merged_report(report: dict, *, source: str | None = None,
+                         directory: str | None = None) -> str | None:
+    """Append a ``bst telemetry-merge`` pod report to the history store
+    so `bst history` / `bst perf-diff` cover multi-process runs, not only
+    the single-process finalize paths. The merged report's summed span
+    table / metric totals / stage rows diff exactly like a manifest's;
+    ``seconds`` is the pod wall clock (max over ranks) and ``status`` is
+    ok only when every rank's was. No-op unless a history dir is
+    configured."""
+    d = history_dir(directory)
+    if d is None:
+        return None
+    procs = report.get("processes") or []
+    tools = sorted({p.get("tool") for p in procs if p.get("tool")})
+    tool = tools[0] if len(tools) == 1 else "pod"
+    statuses = {p.get("status") for p in procs}
+    rid = _next_record_id(f"pod-{tool}")
+    rec = {
+        "schema": SCHEMA, "id": rid,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "source_manifest": os.path.abspath(source)
+        if source else report.get("directory"),
+        "tool": tool,
+        "world": {"process_index": 0,
+                  "process_count": report.get("process_count")},
+        "seconds": report.get("wall_clock_s"),
+        # zero manifests (every rank died before finalize) must not
+        # masquerade as a healthy baseline in perf-diff
+        "status": ("ok" if statuses <= {"ok"} else "error")
+        if procs else "unknown",
+        "spans": report.get("spans") or {},
+        "metrics": report.get("metrics") or {},
+        "stages": report.get("stages") or [],
+        "params": {"merged_processes": len(procs),
+                   "tools": tools,
+                   "directory": report.get("directory")},
+    }
+    return _write_record(d, rid, rec)
 
 
 def list_records(directory: str | None = None) -> list[dict]:

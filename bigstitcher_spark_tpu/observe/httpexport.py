@@ -93,9 +93,16 @@ def process_stats() -> dict:
 # -- providers ---------------------------------------------------------------
 # status() -> dict; health() -> (ok: bool, payload: dict); jobs() -> list.
 # The daemon swaps its own in; the defaults describe a bare process.
+# Above them sits a second, independently-owned layer the telemetry
+# relay collector (observe/relay.py) attaches: cluster_health(ok,
+# payload) -> (ok, payload) merges the pod verdict into /healthz,
+# cluster() -> dict feeds the /cluster endpoint, metrics_extra() -> str
+# appends the host/process_index-labeled per-rank series to /metrics.
 
 _plock = threading.Lock()
-_PROVIDERS: dict = {"status": None, "health": None, "jobs": None}
+_PROVIDERS: dict = {"status": None, "health": None, "jobs": None,
+                    "cluster_health": None, "cluster": None,
+                    "metrics_extra": None}
 
 
 def set_providers(status=None, health=None, jobs=None) -> None:
@@ -111,6 +118,26 @@ def set_providers(status=None, health=None, jobs=None) -> None:
 def clear_providers() -> None:
     with _plock:
         _PROVIDERS.update(status=None, health=None, jobs=None)
+
+
+def set_cluster_providers(health=None, cluster=None,
+                          metrics_extra=None) -> None:
+    """The relay collector's layer — separate setters so a daemon drain
+    (clear_providers) never tears down the cluster plane, and vice
+    versa."""
+    with _plock:
+        if health is not None:
+            _PROVIDERS["cluster_health"] = health
+        if cluster is not None:
+            _PROVIDERS["cluster"] = cluster
+        if metrics_extra is not None:
+            _PROVIDERS["metrics_extra"] = metrics_extra
+
+
+def clear_cluster_providers() -> None:
+    with _plock:
+        _PROVIDERS.update(cluster_health=None, cluster=None,
+                          metrics_extra=None)
 
 
 def _provider(name: str):
@@ -154,13 +181,22 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 _metrics.counter("bst_http_requests_total",
                                  endpoint="metrics").inc()
                 process_stats()   # refresh the self-gauges pre-render
-                body = _metrics.get_registry().render_prometheus().encode()
-                self._send(200, body, "text/plain; version=0.0.4")
+                text = _metrics.get_registry().render_prometheus()
+                extra = _provider("metrics_extra")
+                if extra is not None:
+                    try:
+                        text += extra()
+                    except Exception:
+                        pass   # a broken relay must not cost /metrics
+                self._send(200, text.encode(), "text/plain; version=0.0.4")
             elif path == "/healthz":
                 _metrics.counter("bst_http_requests_total",
                                  endpoint="healthz").inc()
                 health = _provider("health") or _default_health
                 ok, payload = health()
+                cluster_health = _provider("cluster_health")
+                if cluster_health is not None:
+                    ok, payload = cluster_health(ok, payload)
                 self._send_json(200 if ok else 503, payload)
             elif path in ("/status", "/"):
                 _metrics.counter("bst_http_requests_total",
@@ -174,10 +210,22 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 jobs = _provider("jobs")
                 self._send_json(200, {"jobs": jobs() if jobs is not None
                                       else []})
+            elif path == "/cluster":
+                _metrics.counter("bst_http_requests_total",
+                                 endpoint="cluster").inc()
+                cluster = _provider("cluster")
+                if cluster is None:
+                    self._send_json(404, {
+                        "error": "no relay collector in this process — "
+                                 "set BST_TELEMETRY_RELAY (or `bst serve "
+                                 "--relay`) to aggregate a pod here"})
+                else:
+                    self._send_json(200, cluster())
             else:
                 self._send_json(404, {"error": f"no such endpoint {path!r}",
                                       "endpoints": ["/metrics", "/healthz",
-                                                    "/status", "/jobs"]})
+                                                    "/status", "/jobs",
+                                                    "/cluster"]})
         except (BrokenPipeError, ConnectionResetError):
             pass   # scraper went away mid-response
         except Exception as e:   # a broken provider must not kill the server
@@ -185,6 +233,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send_json(500, {"error": repr(e)[:500]})
             except OSError:
                 pass
+
+
+def display_host(host: str | None) -> str:
+    """A connectable spelling of a bind host for echoes and URLs:
+    wildcard binds answer on loopback."""
+    if not host or host in ("0.0.0.0", "::"):
+        return "127.0.0.1"
+    return host
 
 
 class Exporter:
@@ -202,7 +258,8 @@ class Exporter:
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        return (f"http://{display_host(self._server.server_address[0])}:"
+                f"{self.port}")
 
     def stop(self) -> None:
         self._server.shutdown()
@@ -218,12 +275,18 @@ def active() -> Exporter | None:
     return _EXPORTER
 
 
-def start(port: int, host: str = "127.0.0.1") -> Exporter:
+def start(port: int, host: str | None = None) -> Exporter:
     """Bind and serve on ``host:port`` (``port=0`` asks the OS for a free
     one — note the knob path treats 0 as OFF; programmatic/explicit-flag
-    callers use 0 for ephemeral test/doc daemons). Returns the existing
-    exporter when one is already running (singleton)."""
+    callers use 0 for ephemeral test/doc daemons). ``host`` defaults to
+    the ``BST_METRICS_HOST`` knob (127.0.0.1 — a pod's rank-0 exporter
+    sets 0.0.0.0 so the aggregated plane is scrapeable from outside the
+    host; the server has NO auth, so only widen the bind on a trusted
+    network). Returns the existing exporter when one is already running
+    (singleton)."""
     global _EXPORTER
+    if host is None:
+        host = config.get_str("BST_METRICS_HOST") or "127.0.0.1"
     with _elock:
         if _EXPORTER is not None:
             return _EXPORTER

@@ -90,6 +90,23 @@ METRICS: dict[str, str] = {
     # manifest history store (observe/history.py)
     "bst_history_records_total":
         "run/job manifests appended to the BST_HISTORY_DIR history store",
+    # cross-host telemetry relay (observe/relay.py): non-zero ranks push
+    # metric snapshots / heartbeats / warn events to the rank-0 collector
+    # through a bounded queue that drops (and counts) under backpressure
+    "bst_relay_sent_total":
+        "relay messages shipped to the collector by this push client",
+    "bst_relay_send_bytes_total":
+        "serialized relay bytes shipped to the collector",
+    "bst_relay_dropped_total":
+        "relay messages dropped instead of blocking the producing rank, "
+        "labeled by reason (queue = bounded queue full, conn = collector "
+        "unreachable)",
+    "bst_relay_reconnects_total":
+        "successful relay client reconnects after a lost collector",
+    "bst_relay_recv_total":
+        "relay messages received by this collector, labeled by type",
+    "bst_relay_ranks_connected":
+        "push clients currently connected to this relay collector",
     # serve daemon (serve/): queue + lifecycle + per-job cache warmth
     "bst_serve_jobs_submitted_total": "jobs accepted by the serve daemon",
     "bst_serve_jobs_completed_total":
@@ -205,6 +222,15 @@ SPANS: dict[str, str] = {
     "solve.reduce":
         "host fetch of a device solve's final models/errors (the single "
         "drain point of a solve call)",
+    # cross-host telemetry relay (observe/relay.py)
+    "relay.send":
+        "one relay message's serialization + socket send on the client's "
+        "relay thread (never the producing hot path)",
+    "relay.connect":
+        "the relay client (re)connected to its collector (instant)",
+    "relay.dump":
+        "a cluster-wide flight-recorder pull: request every connected "
+        "rank's live ring, fold with the local one into one Perfetto file",
     # streaming stage-DAG executor (dag/executor.py, dag/stream.py)
     "dag.stage": "one pipeline stage's full execution on its thread",
     "dag.wait":

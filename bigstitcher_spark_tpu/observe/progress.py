@@ -21,6 +21,40 @@ from . import events, metrics
 _rec_lock = threading.Lock()
 _records: list[dict] = []
 
+# live last-progress row for the telemetry relay (observe/relay.py): the
+# push client ships it with every heartbeat so `bst top --cluster` shows
+# a remote rank's stage/done/total without any event-log plumbing.
+# Tracking is OFF by default — a run without an active relay client pays
+# nothing beyond the existing events.enabled() check.
+_live_lock = threading.Lock()
+_live: dict | None = None
+_track_live = False
+_track_count = 0
+
+
+def set_live_tracking(on: bool) -> None:
+    """Refcounted on/off (tests run several relay clients in one
+    process; production runs exactly one)."""
+    global _track_live, _live, _track_count
+    with _live_lock:
+        _track_count = max(0, _track_count + (1 if on else -1))
+        _track_live = _track_count > 0
+        if not _track_live:
+            _live = None
+
+
+def latest() -> dict | None:
+    """The most recent stage-progress row (relay tracking only)."""
+    with _live_lock:
+        return dict(_live) if _live is not None else None
+
+
+def _set_live(**row) -> None:
+    global _live
+    with _live_lock:
+        if _track_live:
+            _live = {k: v for k, v in row.items() if v is not None}
+
 
 def reset_records() -> None:
     with _rec_lock:
@@ -86,13 +120,15 @@ class Heartbeat:
         self._counter = metrics.counter("bst_stage_items_done_total",
                                         stage=stage)
         self._finished = False
+        _set_live(stage=stage, done=0, total=self.total,
+                  ts=round(time.time(), 3))
         events.emit("stage.start", stage=stage, total=self.total)
 
     def tick(self, n: int = 1) -> None:
         self._counter.inc(n)
         with self._lock:
             self._done += n
-            if not events.enabled():
+            if not events.enabled() and not _track_live:
                 return
             now = time.perf_counter()
             done, total = self._done, self.total
@@ -105,9 +141,13 @@ class Heartbeat:
             if self._eta_first_s is None:
                 # projected total duration at the first estimate
                 self._eta_first_s = elapsed + eta_s
-        events.emit("stage.progress", stage=self.stage, done=done,
-                    total=total, rate_per_s=round(rate, 3),
-                    eta_s=round(eta_s, 1))
+        _set_live(stage=self.stage, done=done, total=total,
+                  rate_per_s=round(rate, 3), eta_s=round(eta_s, 1),
+                  ts=round(time.time(), 3))
+        if events.enabled():
+            events.emit("stage.progress", stage=self.stage, done=done,
+                        total=total, rate_per_s=round(rate, 3),
+                        eta_s=round(eta_s, 1))
 
     def retry_round(self) -> None:
         with self._lock:
@@ -131,6 +171,9 @@ class Heartbeat:
                 rec["eta_first_s"] = round(self._eta_first_s, 3)
                 rec["eta_error_s"] = round(elapsed - self._eta_first_s, 3)
         rec.update({k: v for k, v in extra.items() if v is not None})
+        _set_live(stage=self.stage, done=rec["done"], total=rec["total"],
+                  rate_per_s=rec["rate_per_s"], finished=True,
+                  ts=round(time.time(), 3))
         events.emit("stage.end", **rec)
         _append_record(rec)
         return rec

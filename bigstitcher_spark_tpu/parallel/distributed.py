@@ -37,44 +37,68 @@ def init_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    start_relay: bool = True,
 ) -> bool:
     """Initialize the multi-host runtime (jax.distributed) once per process.
 
     Arguments default to ``BST_COORDINATOR`` / ``BST_NUM_PROCESSES`` /
     ``BST_PROCESS_ID``; returns True when a multi-process runtime was set up,
-    False for the ordinary single-process case (no env, no args)."""
-    if _initialized[0]:
-        return True
-    from .. import config
+    False for the ordinary single-process case (no env, no args).
 
-    coordinator_address = (coordinator_address
-                           or config.get_str("BST_COORDINATOR"))
-    # topology knobs parse via raw_value + int() so a malformed value
-    # aborts the launch loudly — config.get's unparseable-falls-back rule
-    # would silently run this host single-process while the rest of the
-    # pod blocks at the first barrier
-    raw_np = config.raw_value("BST_NUM_PROCESSES")
-    if num_processes is None and raw_np is not None:
-        num_processes = int(raw_np)
-    raw_pid = config.raw_value("BST_PROCESS_ID")
-    if process_id is None and raw_pid is not None:
-        process_id = int(raw_pid)
-    import jax
-
-    if coordinator_address is None and num_processes is None:
-        if config.get_bool("BST_DISTRIBUTED"):
-            # Cloud TPU pod / SLURM: topology autodetected by jax
-            jax.distributed.initialize()
-            _initialized[0] = True
+    The telemetry relay (observe/relay.py) brings up beside the runtime
+    whenever ``BST_TELEMETRY_RELAY`` is set — rank 0 collects, everyone
+    else pushes — so the pod's live plane exists from the first stage.
+    ``start_relay=False`` skips it (short management/client tools that
+    have nothing live to report)."""
+    try:
+        if _initialized[0]:
             return True
-        return False
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
-    _initialized[0] = True
-    return True
+        from .. import config
+
+        coordinator_address = (coordinator_address
+                               or config.get_str("BST_COORDINATOR"))
+        # topology knobs parse via raw_value + int() so a malformed value
+        # aborts the launch loudly — config.get's unparseable-falls-back
+        # rule would silently run this host single-process while the rest
+        # of the pod blocks at the first barrier
+        raw_np = config.raw_value("BST_NUM_PROCESSES")
+        if num_processes is None and raw_np is not None:
+            num_processes = int(raw_np)
+        raw_pid = config.raw_value("BST_PROCESS_ID")
+        if process_id is None and raw_pid is not None:
+            process_id = int(raw_pid)
+        import jax
+
+        if coordinator_address is None and num_processes is None:
+            if config.get_bool("BST_DISTRIBUTED"):
+                # Cloud TPU pod / SLURM: topology autodetected by jax
+                jax.distributed.initialize()
+                _initialized[0] = True
+                return True
+            return False
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized[0] = True
+        return True
+    finally:
+        if start_relay:
+            _relay_bringup()
+
+
+def _relay_bringup() -> None:
+    """Knob-gated, idempotent, and never fatal: losing the pod's live
+    view must not block the launch it observes."""
+    from ..observe import relay
+
+    try:
+        relay.ensure_started()
+    except Exception as e:
+        from ..observe import log
+
+        log(f"telemetry relay disabled: {e!r}", stage="observe")
 
 
 def barrier(name: str = "bst") -> None:

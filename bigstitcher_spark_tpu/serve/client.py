@@ -42,13 +42,24 @@ def status(socket_path: str | None = None) -> dict:
 
 
 def trace_dump(socket_path: str | None = None,
-               out: str | None = None) -> dict:
+               out: str | None = None, cluster: bool = False) -> dict:
     """Snapshot the daemon's live flight-recorder ring to Perfetto JSON
-    (jobs keep running); returns ``{"path": ..., recorder stats...}``."""
+    (jobs keep running); returns ``{"path": ..., recorder stats...}``.
+    ``cluster=True`` additionally pulls every relay-connected rank's
+    live ring and folds them into the one barrier-aligned file."""
     req: dict = {"op": "trace-dump"}
     if out:
         req["out"] = out
-    return _one_shot(socket_path, req)
+    if cluster:
+        req["cluster"] = True
+    # a cluster pull waits up to the collector's per-rank timeout
+    return _one_shot(socket_path, req, timeout=60.0 if cluster else 30.0)
+
+
+def cluster_status(socket_path: str | None = None) -> dict:
+    """The relay collector's per-rank view (what /cluster also serves);
+    raises RuntimeError when the daemon hosts no collector."""
+    return _one_shot(socket_path, {"op": "cluster"})
 
 
 def cancel(socket_path: str | None, job_id: str) -> dict:

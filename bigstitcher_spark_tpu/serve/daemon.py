@@ -141,7 +141,8 @@ class Daemon:
                  slots: int | None = None,
                  jobs_root: str | None = None,
                  idle_timeout: float | None = None,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 relay: str | None = None):
         self.socket_path = socket_path or protocol.default_socket_path()
         self.slots = slots if slots is not None else \
             max(1, config.get_int("BST_SERVE_SLOTS") or 1)
@@ -156,6 +157,12 @@ class Daemon:
         # self.metrics_port / the ping response
         self._metrics_port_arg = metrics_port
         self.metrics_port = 0
+        # telemetry relay collector: an explicit --relay host:port beats
+        # the BST_TELEMETRY_RELAY knob; a daemon always HOSTS (it is the
+        # pod's natural fan-in point — multi-host daemons inherit the
+        # aggregated live plane for free)
+        self._relay_arg = relay
+        self._own_relay = False
         self.queue = JobQueue(self.slots)
         self.started_at = time.time()
         self._sock: socket.socket | None = None
@@ -197,6 +204,7 @@ class Daemon:
         with self._lock:
             self._router = _StdoutRouter()   # installs itself per job
         self._start_exporter()
+        self._start_relay()
         for slot in range(self.slots):
             th = ctx_thread(self._slot_loop, (slot,),
                             name=f"bst-serve-slot-{slot}")
@@ -213,9 +221,11 @@ class Daemon:
                     f"{self.device_info.get('local_device_count', '?')} "
                     f"device(s))", stage="serve")
         if self.metrics_port:
-            observe.log(f"bst serve: live exporter on "
-                        f"http://127.0.0.1:{self.metrics_port} "
-                        f"(/metrics /healthz /status /jobs)",
+            exp = httpexport.active()
+            url = exp.url if exp is not None \
+                else f"http://127.0.0.1:{self.metrics_port}"
+            observe.log(f"bst serve: live exporter on {url} "
+                        f"(/metrics /healthz /status /jobs /cluster)",
                         stage="serve")
         return self
 
@@ -240,6 +250,26 @@ class Daemon:
                                      health=self._health,
                                      jobs=self._jobs_payload)
             self.metrics_port = exp.port
+
+    def _start_relay(self) -> None:
+        """Host the pod telemetry collector (--relay / the knob) so the
+        daemon's /metrics, /healthz and /cluster aggregate every relayed
+        rank; bind failure downgrades, never a crash."""
+        from ..observe import relay as _relay
+
+        addr = (self._relay_arg if self._relay_arg is not None
+                else config.get_str("BST_TELEMETRY_RELAY"))
+        if not addr or _relay.collector() is not None:
+            return
+        try:
+            col = _relay.serve(addr)
+        except (OSError, ValueError) as e:
+            observe.log(f"bst serve: relay collector disabled ({e})",
+                        stage="serve")
+            return
+        self._own_relay = True
+        observe.log(f"bst serve: telemetry relay collecting on "
+                    f"{col.host}:{col.port}", stage="serve")
 
     def _warm_mesh(self) -> None:
         """Pay jax init + device placement ONCE, before accepting work;
@@ -303,6 +333,11 @@ class Daemon:
         if router is not None and sys.stdout is router:
             sys.stdout = router._real   # no job left it installed
         httpexport.clear_providers()
+        if self._own_relay:
+            from ..observe import relay as _relay
+
+            _relay.stop_collector()   # frees the address, clears the
+            #                           cluster providers it attached
         if self._own_exporter:
             httpexport.stop()   # frees the port for the next daemon
         if self._own_trace and _trace.enabled():
@@ -347,10 +382,12 @@ class Daemon:
                 return
             op = req.get("op")
             if op == "ping":
+                rly = self._relay_summary()
                 protocol.send_line(f, {
                     "event": "pong", "pid": os.getpid(),
                     "uptime_s": self.uptime_s(),
                     "metrics_port": self.metrics_port,
+                    "relay": rly["address"] if rly else None,
                     "device": self.device_info})
             elif op == "jobs":
                 protocol.send_line(f, {"event": "jobs",
@@ -371,6 +408,8 @@ class Daemon:
                                        "status": self._status()})
             elif op == "trace-dump":
                 self._op_trace_dump(f, req)
+            elif op == "cluster":
+                self._op_cluster(f)
             else:
                 protocol.send_line(f, {"event": "error",
                                        "error": f"unknown op {op!r}"})
@@ -390,6 +429,16 @@ class Daemon:
     def _stalled_jobs(self) -> list[str]:
         return [j.id for j in self.queue.jobs()
                 if j.stalled and j.state == RUNNING]
+
+    def _relay_summary(self) -> dict | None:
+        from ..observe import relay as _relay
+
+        col = _relay.collector()
+        if col is None:
+            return None
+        doc = col.cluster_status()["collector"]
+        return {"address": doc["address"], "ranks": doc["ranks"],
+                "connected": doc["connected"]}
 
     def _status(self) -> dict:
         from ..io.chunkcache import get_cache
@@ -436,6 +485,8 @@ class Daemon:
                     "bst_dag_consumer_wait_seconds_total").value,
             },
             "trace": _trace.stats(),
+            # the relay collector's pod summary (None when not hosting)
+            "relay": self._relay_summary(),
         }
 
     def _health(self) -> tuple[bool, dict]:
@@ -499,13 +550,35 @@ class Daemon:
     def _op_trace_dump(self, f, req: dict) -> None:
         """Snapshot the live flight-recorder ring to Perfetto JSON
         without pausing jobs (the ring copy happens under the trace
-        lock; the recorder keeps recording)."""
+        lock; the recorder keeps recording). With ``cluster`` set, the
+        relay collector additionally pulls every connected rank's live
+        ring and folds them — barrier-aligned — into the one file."""
         out = req.get("out")
         if not out:
             with self._lock:
                 self._dump_seq += 1
                 n = self._dump_seq
             out = os.path.join(self.jobs_root, f"trace-dump-{n:04d}.json")
+        if req.get("cluster"):
+            from ..observe import relay as _relay
+
+            col = _relay.collector()
+            if col is None:
+                protocol.send_line(f, {
+                    "event": "error",
+                    "error": "no relay collector in this daemon — start "
+                             "it with --relay HOST:PORT (or "
+                             "BST_TELEMETRY_RELAY)"})
+                return
+            try:
+                res = col.cluster_trace_dump(os.path.abspath(str(out)))
+            except (RuntimeError, OSError) as e:
+                protocol.send_line(f, {"event": "error", "error": str(e)})
+                return
+            _trace.instant("serve.trace_dump",
+                           item=os.path.basename(res["path"]))
+            protocol.send_line(f, {"event": "trace-dump", **res})
+            return
         try:
             path = _trace.dump_live(os.path.abspath(str(out)))
         except (RuntimeError, OSError) as e:
@@ -514,6 +587,21 @@ class Daemon:
         _trace.instant("serve.trace_dump", item=os.path.basename(path))
         protocol.send_line(f, {"event": "trace-dump", "path": path,
                                **_trace.stats()})
+
+    def _op_cluster(self, f) -> None:
+        """The /cluster JSON over the daemon socket (`bst top --cluster`
+        without an HTTP exporter)."""
+        from ..observe import relay as _relay
+
+        col = _relay.collector()
+        if col is None:
+            protocol.send_line(f, {
+                "event": "error",
+                "error": "no relay collector in this daemon — start it "
+                         "with --relay HOST:PORT (or "
+                         "BST_TELEMETRY_RELAY)"})
+            return
+        protocol.send_line(f, {"event": "cluster", **col.cluster_status()})
 
     # -- stall watchdog ------------------------------------------------------
 
@@ -796,7 +884,8 @@ def _streaming_forwarder(job: Job):
 def run_foreground(socket_path: str | None = None, slots: int | None = None,
                    jobs_root: str | None = None,
                    idle_timeout: float | None = None,
-                   metrics_port: int | None = None) -> int:
+                   metrics_port: int | None = None,
+                   relay: str | None = None) -> int:
     """``bst serve`` without --detach: start, block until shutdown.
 
     Signal handling lives HERE, not in Daemon.start(): only the
@@ -804,7 +893,8 @@ def run_foreground(socket_path: str | None = None, slots: int | None = None,
     requires) — an in-process daemon (tests, bench) must never hijack
     its host's SIGINT/SIGTERM. Previous handlers are restored on exit."""
     d = Daemon(socket_path, slots=slots, jobs_root=jobs_root,
-               idle_timeout=idle_timeout, metrics_port=metrics_port)
+               idle_timeout=idle_timeout, metrics_port=metrics_port,
+               relay=relay)
     d.start()
     prev = {}
     if threading.current_thread() is threading.main_thread():
@@ -825,6 +915,7 @@ def spawn_detached(socket_path: str | None = None, slots: int | None = None,
                    jobs_root: str | None = None,
                    idle_timeout: float | None = None,
                    metrics_port: int | None = None,
+                   relay: str | None = None,
                    ready_timeout: float = 180.0) -> int:
     """``bst serve --detach``: fork a daemon subprocess, wait until its
     socket answers ping, return its pid."""
@@ -851,6 +942,8 @@ def spawn_detached(socket_path: str | None = None, slots: int | None = None,
         args += ["--idle-timeout", str(int(idle_timeout))]
     if metrics_port is not None:
         args += ["--metrics-port", str(int(metrics_port))]
+    if relay is not None:
+        args += ["--relay", relay]
     log_path = path + ".log"
     with open(log_path, "ab") as logf:
         proc = subprocess.Popen(args, stdout=logf, stderr=logf, env=env,
