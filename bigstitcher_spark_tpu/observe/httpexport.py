@@ -96,13 +96,16 @@ def process_stats() -> dict:
 # Above them sits a second, independently-owned layer the telemetry
 # relay collector (observe/relay.py) attaches: cluster_health(ok,
 # payload) -> (ok, payload) merges the pod verdict into /healthz,
-# cluster() -> dict feeds the /cluster endpoint, metrics_extra() -> str
-# appends the host/process_index-labeled per-rank series to /metrics.
+# cluster() -> dict feeds the /cluster endpoint, and
+# metrics_render(local_text) -> str replaces the /metrics body with the
+# family-merged cluster render (local series plus one
+# host/process_index-labeled copy per rank, each metric family kept
+# contiguous so the exposition stays spec-valid).
 
 _plock = threading.Lock()
 _PROVIDERS: dict = {"status": None, "health": None, "jobs": None,
                     "cluster_health": None, "cluster": None,
-                    "metrics_extra": None}
+                    "metrics_render": None}
 
 
 def set_providers(status=None, health=None, jobs=None) -> None:
@@ -121,7 +124,7 @@ def clear_providers() -> None:
 
 
 def set_cluster_providers(health=None, cluster=None,
-                          metrics_extra=None) -> None:
+                          metrics_render=None) -> None:
     """The relay collector's layer — separate setters so a daemon drain
     (clear_providers) never tears down the cluster plane, and vice
     versa."""
@@ -130,14 +133,14 @@ def set_cluster_providers(health=None, cluster=None,
             _PROVIDERS["cluster_health"] = health
         if cluster is not None:
             _PROVIDERS["cluster"] = cluster
-        if metrics_extra is not None:
-            _PROVIDERS["metrics_extra"] = metrics_extra
+        if metrics_render is not None:
+            _PROVIDERS["metrics_render"] = metrics_render
 
 
 def clear_cluster_providers() -> None:
     with _plock:
         _PROVIDERS.update(cluster_health=None, cluster=None,
-                          metrics_extra=None)
+                          metrics_render=None)
 
 
 def _provider(name: str):
@@ -182,10 +185,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                                  endpoint="metrics").inc()
                 process_stats()   # refresh the self-gauges pre-render
                 text = _metrics.get_registry().render_prometheus()
-                extra = _provider("metrics_extra")
-                if extra is not None:
+                render = _provider("metrics_render")
+                if render is not None:
                     try:
-                        text += extra()
+                        merged = render(text)
+                        if isinstance(merged, str):
+                            text = merged
                     except Exception:
                         pass   # a broken relay must not cost /metrics
                 self._send(200, text.encode(), "text/plain; version=0.0.4")

@@ -91,6 +91,37 @@ def parse_address(addr: str) -> tuple[str, int]:
     return host, int(port)
 
 
+def _set_keepalive(sock: socket.socket) -> None:
+    """Both relay roles hold long-lived mostly-idle connections whose
+    readers treat silence as normal, so a HALF-OPEN peer (host
+    power-cut, no FIN/RST) would otherwise look alive indefinitely —
+    the client until its send buffer fills, the collector until TCP
+    retransmission gives up (~15 min), leaving a phantom connected rank
+    that stalls every cluster dump for its full timeout. Keepalive
+    probes surface dead peers to the blocked recv in ~25s. Each option
+    is guarded on its own — TCP_KEEPALIVE is the Darwin spelling of the
+    idle time, and a sandbox denying one setsockopt must neither kill
+    the relay thread nor abandon the remaining tuning."""
+    with contextlib.suppress(OSError):
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    for opt, val in (("TCP_KEEPIDLE", 10), ("TCP_KEEPALIVE", 10),
+                     ("TCP_KEEPINTVL", 5), ("TCP_KEEPCNT", 3)):
+        o = getattr(socket, opt, None)
+        if o is not None:
+            with contextlib.suppress(OSError):
+                sock.setsockopt(socket.IPPROTO_TCP, o, val)
+
+
+def _shutdown_close(sock: socket.socket) -> None:
+    """shutdown(SHUT_RDWR) then close: a handler thread's makefile
+    holds an io-ref, so close() alone defers the real close and leaves
+    the connection (and the remote client) fully alive."""
+    with contextlib.suppress(OSError):
+        sock.shutdown(socket.SHUT_RDWR)
+    with contextlib.suppress(OSError):
+        sock.close()
+
+
 def _identity() -> tuple[str, int, int]:
     """(host, process_index, process_count) of THIS process. The
     explicit BST_PROCESS_ID / BST_NUM_PROCESSES launch env wins over the
@@ -267,7 +298,7 @@ class RelayClient:
                         return
                     sock.sendall(data)
             except OSError:
-                self._close_sock()
+                self._close_sock(sock)
                 _DROP_CONN.inc()
                 return
         _SENT.inc()
@@ -288,6 +319,7 @@ class RelayClient:
         # sends must eventually error on a dead-but-open collector so
         # the client falls back to dropping instead of wedging forever
         sock.settimeout(10.0)
+        _set_keepalive(sock)
         hello = (json.dumps({
             "t": "hello", "schema": SCHEMA, "host": self.host,
             "process_index": self.process_index,
@@ -313,35 +345,59 @@ class RelayClient:
                          name="bst-relay-reader", daemon=True).start()
         return True
 
-    def _close_sock(self) -> None:
+    def _close_sock(self, expected: socket.socket | None = None) -> None:
+        """Drop the current connection; with ``expected`` given, only if
+        it is still the current one — a check-then-close outside the
+        lock could otherwise tear down a connection a concurrent
+        reconnect just established (one spurious reconnect cycle: the
+        very flap the idle-tolerant reader exists to prevent)."""
         with self._sock_lock:
-            sock, self._sock = self._sock, None
+            sock = self._sock
+            if expected is not None and sock is not expected:
+                return
+            self._sock = None
         self.connected.clear()
         if sock is not None:
-            with contextlib.suppress(OSError):
-                sock.close()
+            _shutdown_close(sock)   # also wakes a reader blocked in recv
 
     def _reader(self, sock: socket.socket) -> None:
         """Collector->client requests (cluster trace pulls) arrive on
         the same connection; responses go back through the bounded
-        queue so the relay thread stays the only socket writer."""
+        queue so the relay thread stays the only socket writer. The
+        socket timeout exists for the WRITER (a wedged sendall must
+        eventually error) — the collector is silent except for trace
+        pulls, so a read timing out just means idle: keep listening.
+        Only EOF or a real socket error tears the connection down."""
+        buf = b""
         try:
-            f = sock.makefile("rb")
-            for line in f:
+            # deliberately NOT gated on _stop: stop() drains the queue
+            # and sends the goodbye AFTER setting it — a reader that
+            # exited on the flag mid-drain would close the socket under
+            # that final sendall. stop()'s own _close_sock (after the
+            # relay thread joins) wakes the blocked recv to exit.
+            while sock is self._sock:
                 try:
-                    msg = json.loads(line)
-                except ValueError:
-                    continue
-                if not isinstance(msg, dict):
-                    continue
-                if msg.get("t") == "trace-dump":
-                    self.offer({"t": "trace", "req": msg.get("req"),
-                                "doc": self._trace_doc()})
+                    chunk = sock.recv(65536)
+                except TimeoutError:
+                    continue   # idle connection — normal, not a failure
+                if not chunk:
+                    break   # EOF: the collector closed on us
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    try:
+                        msg = json.loads(line)
+                    except ValueError:
+                        continue
+                    if not isinstance(msg, dict):
+                        continue
+                    if msg.get("t") == "trace-dump":
+                        self.offer({"t": "trace", "req": msg.get("req"),
+                                    "doc": self._trace_doc()})
         except OSError:
             pass
         finally:
-            if sock is self._sock:
-                self._close_sock()
+            self._close_sock(sock)
 
     def _trace_doc(self) -> dict | None:
         if not _trace.enabled():
@@ -352,24 +408,66 @@ class RelayClient:
 # -- collector ---------------------------------------------------------------
 
 
-def _relabel(prom_text: str, host: str, process_index: int) -> str:
-    """Inject ``host``/``process_index`` labels into every series line
-    of a Prometheus exposition (comment lines drop — the unlabeled local
-    render already carried the TYPE lines once)."""
-    inject = (f'host="{host}",process_index="{process_index}"')
+def _merge_expositions(texts: list) -> str:
+    """Merge ``(host, process_index, prometheus_text)`` expositions into
+    ONE valid exposition: every metric family appears exactly once, as a
+    contiguous group under a single ``# TYPE`` comment holding the
+    series of every source — duplicate or split families are invalid
+    per the Prometheus text-format spec (promtool/OpenMetrics reject
+    them even though the scraper tolerates them). ``host=None`` marks
+    the local render (series pass through unlabeled); every other
+    source gets ``host``/``process_index`` injected into each series."""
+    fams: dict[str, dict] = {}   # insertion-ordered: first sight wins
+
+    def fam(name: str) -> dict:
+        f = fams.get(name)
+        if f is None:
+            f = fams[name] = {"type": None, "lines": []}
+        return f
+
+    for host, pi, text in texts:
+        inject = (None if host is None
+                  else f'host="{host}",process_index="{pi}"')
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                parts = line.split()
+                if len(parts) >= 4 and fam(parts[2])["type"] is None:
+                    fams[parts[2]]["type"] = parts[3]
+                continue
+            if line.startswith("#"):
+                continue
+            name_part, _, value = line.rpartition(" ")
+            if not name_part:
+                continue
+            if "{" in name_part:
+                name, rest = name_part.split("{", 1)
+                series = (line if inject is None
+                          else f"{name}{{{inject},{rest} {value}")
+            else:
+                name = name_part
+                series = (line if inject is None
+                          else f"{name}{{{inject}}} {value}")
+            # histogram sample suffixes group under the parent family
+            # (whose TYPE line precedes its series in every render)
+            base = name
+            for suf in ("_bucket", "_sum", "_count"):
+                if name.endswith(suf):
+                    parent = fams.get(name[:-len(suf)])
+                    if parent is not None and parent["type"] in (
+                            "histogram", "summary"):
+                        base = name[:-len(suf)]
+                    break
+            fam(base)["lines"].append(series)
     out: list[str] = []
-    for line in prom_text.splitlines():
-        if not line or line.startswith("#"):
+    for name, f in fams.items():
+        if not f["lines"]:
             continue
-        name_part, _, value = line.rpartition(" ")
-        if not name_part:
-            continue
-        if "{" in name_part:
-            name, rest = name_part.split("{", 1)
-            out.append(f"{name}{{{inject},{rest} {value}")
-        else:
-            out.append(f"{name_part}{{{inject}}} {value}")
-    return "\n".join(out)
+        if f["type"] is not None:
+            out.append(f"# TYPE {name} {f['type']}")
+        out.extend(f["lines"])
+    return "\n".join(out) + "\n"
 
 
 class RelayCollector:
@@ -407,7 +505,7 @@ class RelayCollector:
         self._threads.append(th)
         _httpexport.set_cluster_providers(health=self.pod_health,
                                           cluster=self.cluster_status,
-                                          metrics_extra=self.metrics_text)
+                                          metrics_render=self.metrics_render)
         return self
 
     def stop(self) -> None:
@@ -421,8 +519,7 @@ class RelayCollector:
             conns = [r.get("conn") for r in self._ranks.values()]
         for c in conns:
             if c is not None:
-                with contextlib.suppress(OSError):
-                    c.close()
+                _shutdown_close(c)
         for th in self._threads:
             if th is not threading.current_thread():
                 th.join(timeout=5)
@@ -438,6 +535,10 @@ class RelayCollector:
                 continue
             except OSError:
                 break
+            # accepted sockets don't inherit the listener's options and
+            # the handler blocks in a plain read — without keepalive a
+            # no-FIN dead worker stays a phantom connected rank
+            _set_keepalive(conn)
             th = threading.Thread(target=self._handle, args=(conn,),
                                   name="bst-relay-conn", daemon=True)
             th.start()
@@ -474,6 +575,7 @@ class RelayCollector:
                 elif t == "snap":
                     with self._lock:
                         rank["last_seen"] = time.time()
+                        rank["snap_at"] = rank["last_seen"]
                         rank["snap"] = msg.get("payload") or {}
                         rank["done"] = False
                 elif t == "event":
@@ -513,8 +615,7 @@ class RelayCollector:
                         connected=True, done=False,
                         last_seen=time.time())
         if old is not None and old is not conn:
-            with contextlib.suppress(OSError):
-                old.close()
+            _shutdown_close(old)   # wake its handler too
         self._update_connected_gauge()
         return rank
 
@@ -587,24 +688,38 @@ class RelayCollector:
         payload["ok"] = ok
         return ok, payload
 
-    def metrics_text(self) -> str:
-        """host/process_index-labeled copies of every rank's series —
-        the collector's own included (unless a connected rank already
-        claims its identity) — appended to the local /metrics render."""
-        parts = ["# relay-aggregated cluster series (one labeled copy "
-                 "per rank)"]
+    def metrics_render(self, local_text: str) -> str:
+        """The collector's /metrics body: the local registry render
+        merged with a host/process_index-labeled copy of every rank's
+        series — the collector's own included (unless a connected rank
+        already claims its identity). Families merge contiguously under
+        one TYPE comment each, keeping the exposition valid (see
+        :func:`_merge_expositions`); ranks colliding on (host,
+        process_index) — independently-launched workers with mismatched
+        process_count claims occupy distinct _ranks keys — dedupe to
+        the freshest SNAPSHOT (snap_at, not last_seen: heartbeats and
+        events also touch last_seen and must not let a stale snapshot
+        win), since duplicate identical-label samples are as invalid as
+        split families."""
         with self._lock:
-            ranks = [(r["host"], r["process_index"],
-                      (r.get("snap") or {}).get("prom"))
-                     for r in self._ranks.values()]
+            newest: dict = {}
+            for r in self._ranks.values():
+                prom = (r.get("snap") or {}).get("prom")
+                if not prom:
+                    continue
+                k = (r["host"], r["process_index"])
+                snap_at = r.get("snap_at", 0)
+                if k not in newest or snap_at > newest[k][0]:
+                    newest[k] = (snap_at, prom)
         host, pi, _pc = _identity()
-        if not any(h == host and p == pi for h, p, _ in ranks):
-            parts.append(_relabel(
-                _metrics.get_registry().render_prometheus(), host, pi))
-        for h, p, prom in sorted(ranks, key=lambda r: (r[0], r[1])):
-            if prom:
-                parts.append(_relabel(prom, h, p))
-        return "\n".join(parts) + "\n"
+        texts: list = [(None, 0, local_text)]
+        if (host, pi) not in newest:
+            texts.append((host, pi, local_text))
+        texts += [(h, p, prom)
+                  for (h, p), (_seen, prom) in sorted(newest.items())]
+        return ("# relay-aggregated cluster render (one labeled copy "
+                "per rank, families merged)\n"
+                + _merge_expositions(texts))
 
     # -- cluster flight-recorder pull ----------------------------------------
 
@@ -626,13 +741,23 @@ class RelayCollector:
         nothing pauses. Ranks that fail to answer within ``timeout_s``
         are reported missing, never fatal."""
         with _trace.span("relay.dump"):
+            have_local = _trace.enabled()
+            lhost, lpi, lpc = _identity()
             with self._dump_lock:
                 self._dump_seq += 1
                 req = self._dump_seq
             with self._lock:
+                # the hosting rank's self-client would hand back the
+                # very ring the local export below already contributes —
+                # pulling both would duplicate every local event in the
+                # merged file. Identify the self-CONNECTION by pid (an
+                # unrelated same-host worker may legitimately claim the
+                # same process_index — see _identity's collision note)
                 targets = [(k, r["conn"], r["wlock"])
                            for k, r in self._ranks.items()
-                           if r["connected"] and r.get("conn") is not None]
+                           if r["connected"] and r.get("conn") is not None
+                           and not (have_local and k[0] == lhost
+                                    and r.get("pid") == os.getpid())]
             asked = []
             line = (json.dumps({"t": "trace-dump", "req": req})
                     + "\n").encode()
@@ -660,11 +785,8 @@ class RelayCollector:
             docs = [d for d in pend["results"] if d]
             tmpdir = tempfile.mkdtemp(prefix="bst-relay-dump-")
             try:
-                have_local = False
-                if _trace.enabled():
-                    _h, pi, pc = _identity()
-                    docs = [_trace.export(pi, pc), *docs]
-                    have_local = True
+                if have_local:
+                    docs = [_trace.export(lpi, lpc), *docs]
                 written = 0
                 for doc in docs:
                     meta = doc.get("bst") or {}
@@ -759,9 +881,13 @@ def ensure_started():
             pass   # someone on this host already collects — push instead
         else:
             # the hosting rank is a pod member too: push into our own
-            # collector over loopback so /cluster and the pod health
-            # verdict cover rank 0, not only ranks 1..N-1
-            connect(f"127.0.0.1:{col.port}")
+            # collector so /cluster and the pod health verdict cover
+            # rank 0, not only ranks 1..N-1 — via the BOUND interface
+            # (a collector on a routable address has nothing listening
+            # on loopback; wildcard binds map back to 127.0.0.1)
+            from . import httpexport as _httpexport
+
+            connect(f"{_httpexport.display_host(col.host)}:{col.port}")
             return col
     return connect(addr)
 

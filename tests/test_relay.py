@@ -91,12 +91,13 @@ class _FakeRank:
     """A raw-socket push client driven line by line — the protocol-level
     test surface (silence, bye, malformed lines)."""
 
-    def __init__(self, port, host="fake", pi=1, pc=2):
+    def __init__(self, port, host="fake", pi=1, pc=2, pid=None):
         self.sock = socket.create_connection(("127.0.0.1", port),
                                              timeout=5)
         self.identity = {"host": host, "process_index": pi,
                          "process_count": pc}
-        self.send({"t": "hello", **self.identity, "pid": os.getpid()})
+        self.send({"t": "hello", **self.identity,
+                   "pid": pid if pid is not None else os.getpid()})
 
     def send(self, msg: dict) -> None:
         self.sock.sendall((json.dumps(msg) + "\n").encode())
@@ -371,6 +372,192 @@ class TestClusterPlane:
         finally:
             fr.close()
 
+    def test_idle_read_timeout_keeps_connection(self, collector):
+        """The client socket's timeout exists for the WRITER (a wedged
+        sendall must eventually error); the reader idling past it — the
+        collector is silent except for trace pulls — must NOT tear a
+        healthy connection down and reconnect-flap."""
+        c = _mk_client(collector.port, "idle-host", 1)
+        try:
+            _wait_for(lambda: c.connected.is_set(), what="connect")
+            with c._sock_lock:
+                s0 = c._sock
+                s0.settimeout(0.1)   # idle-read timeouts fire fast now
+                # half-open (no FIN/RST) peers are caught by keepalive
+                # probes, not by read-timeout teardown
+                assert s0.getsockopt(socket.SOL_SOCKET,
+                                     socket.SO_KEEPALIVE) == 1
+            r0 = metrics.counter("bst_relay_reconnects_total").value
+            time.sleep(0.8)          # several timeout windows, all idle
+            assert c.connected.is_set()
+            with c._sock_lock:
+                assert c._sock is s0, \
+                    "an idle read timeout dropped a healthy connection"
+            assert metrics.counter(
+                "bst_relay_reconnects_total").value == r0
+            row = next(r for r in collector.cluster_status()["ranks"]
+                       if r["host"] == "idle-host")
+            assert row["connected"]
+            # the COLLECTOR side of the same mostly-idle connection
+            # needs the keepalive hardening too: its handler blocks in
+            # a plain read, so a no-FIN dead worker would otherwise
+            # stay a phantom connected rank (stalling cluster dumps)
+            # until TCP retransmission gives up
+            with collector._lock:
+                conn = next(r["conn"] for r in collector._ranks.values()
+                            if r["host"] == "idle-host")
+            assert conn.getsockopt(socket.SOL_SOCKET,
+                                   socket.SO_KEEPALIVE) == 1
+        finally:
+            c.stop()
+
+    def test_metrics_families_contiguous_and_typed(self, collector):
+        """The aggregated /metrics must stay VALID Prometheus
+        exposition: each metric family exactly once, contiguous, under
+        a single TYPE comment — duplicate or split families are
+        rejected by promtool/OpenMetrics parsers."""
+        exp = httpexport.start(0)
+        c1 = _mk_client(collector.port, "hostA", 0)
+        c2 = _mk_client(collector.port, "hostB", 1)
+        metrics.counter("bst_io_read_bytes_total", op="fmt-test",
+                        path="synthetic").inc(1)
+        try:
+            def scraped():
+                code, body = _get(exp.url + "/metrics")
+                return (code == 200
+                        and 'host="hostA",process_index="0"' in body
+                        and 'host="hostB",process_index="1"' in body
+                        and body)
+
+            body = _wait_for(scraped, what="aggregated scrape")
+            types = {}
+            for line in body.splitlines():
+                if line.startswith("# TYPE "):
+                    _, _, name, typ = line.split()
+                    assert name not in types, f"duplicate TYPE: {name}"
+                    types[name] = typ
+
+            def family(name):
+                for suf in ("_bucket", "_sum", "_count"):
+                    if (name.endswith(suf) and types.get(name[:-len(suf)])
+                            in ("histogram", "summary")):
+                        return name[:-len(suf)]
+                return name
+
+            closed, current = set(), None
+            for line in body.splitlines():
+                if not line or line.startswith("#"):
+                    continue
+                name = family(line.split("{", 1)[0].split(" ", 1)[0])
+                if name != current:
+                    assert name not in closed, \
+                        f"family {name} split into separate groups"
+                    if current is not None:
+                        closed.add(current)
+                    current = name
+                assert name in types, f"series {name} lacks a TYPE line"
+        finally:
+            c1.stop()
+            c2.stop()
+            httpexport.stop()
+
+    def test_colliding_identity_ranks_dedupe_in_metrics(self, collector):
+        """Two ranks claiming the same (host, process_index) but
+        different process_count occupy distinct collector rows; the
+        merged /metrics must carry ONE labeled copy (the freshest), not
+        duplicate identical-label samples."""
+        a = _FakeRank(collector.port, host="dup-host", pi=0, pc=1)
+        b = _FakeRank(collector.port, host="dup-host", pi=0, pc=2)
+        snaps0 = metrics.counter("bst_relay_recv_total",
+                                 type="snap").value
+        try:
+            a.snap(prom="# TYPE x_total counter\nx_total 1\n")
+            _wait_for(lambda: metrics.counter(
+                "bst_relay_recv_total", type="snap").value > snaps0,
+                what="first colliding snap")
+            time.sleep(0.02)   # strictly newer last_seen for b
+            b.snap(prom="# TYPE x_total counter\nx_total 2\n")
+            _wait_for(lambda: metrics.counter(
+                "bst_relay_recv_total", type="snap").value > snaps0 + 1,
+                what="second colliding snap")
+            body = collector.metrics_render(
+                metrics.get_registry().render_prometheus())
+            lines = [l for l in body.splitlines()
+                     if l.startswith('x_total{host="dup-host"')]
+            assert lines == \
+                ['x_total{host="dup-host",process_index="0"} 2']
+            # an EVENT from the stale rank touches last_seen but must
+            # not let its older snapshot win back the identity
+            ev0 = metrics.counter("bst_relay_recv_total",
+                                  type="event").value
+            a.send({"t": "event", "rec": {"type": "retry.round"}})
+            _wait_for(lambda: metrics.counter(
+                "bst_relay_recv_total", type="event").value > ev0,
+                what="stale rank's event")
+            body = collector.metrics_render(
+                metrics.get_registry().render_prometheus())
+            lines = [l for l in body.splitlines()
+                     if l.startswith('x_total{host="dup-host"')]
+            assert lines == \
+                ['x_total{host="dup-host",process_index="0"} 2']
+        finally:
+            a.close()
+            b.close()
+
+    def test_self_hosting_rank_ring_not_duplicated(self, collector,
+                                                   tmp_path, monkeypatch):
+        """A hosting rank that also pushes to itself over loopback
+        (ensure_started) must contribute its flight-recorder ring ONCE
+        to a cluster dump — the direct local export, not a second
+        pulled copy of the same ring."""
+        monkeypatch.setenv("BST_PROCESS_ID", "0")
+        monkeypatch.setenv("BST_NUM_PROCESSES", "2")
+        me = _mk_client(collector.port, socket.gethostname(), 0)
+        other = _mk_client(collector.port, "other-host", 1)
+        try:
+            _wait_for(lambda: collector.cluster_status()["collector"]
+                      ["connected"] == 2, what="both connected")
+            with trace.span("barrier", stage="self-dedup"):
+                pass
+            out = str(tmp_path / "self-dedup-trace.json")
+            res = collector.cluster_trace_dump(out, timeout_s=10)
+            # only the non-self rank was pulled; the local ring rode in
+            # exactly once via the direct export
+            assert res["local_ring"] and res["asked"] == 1
+            assert res["ranks"] == 1 and res["missing"] == 0
+            assert res["traces"] == 2, \
+                "self rank's ring written twice into the merge"
+        finally:
+            me.stop()
+            other.stop()
+
+    def test_same_host_rank0_worker_still_pulled(self, collector,
+                                                 tmp_path):
+        """The self-ring dedup must identify the self-CONNECTION (pid),
+        not the (host, process_index) pair: a separately-launched
+        same-host worker claiming process_index 0 (identity-only rank
+        against a daemon-hosted collector) is NOT this process's ring
+        and must still be asked for its trace."""
+        own = not trace.enabled()
+        if own:
+            trace.configure()
+        fr = _FakeRank(collector.port, host=socket.gethostname(), pi=0,
+                       pid=os.getpid() + 1)
+        try:
+            _wait_for(lambda: any(r["connected"] for r in
+                                  collector.cluster_status()["ranks"]),
+                      what="worker connect")
+            out = str(tmp_path / "same-host-trace.json")
+            res = collector.cluster_trace_dump(out, timeout_s=1.0)
+            # the worker was ASKED (a fake rank never answers, so it
+            # reports missing) instead of silently deduped away
+            assert res["asked"] == 1 and res["missing"] == 1
+            assert res["local_ring"] and res["traces"] == 1
+        finally:
+            fr.close()
+            if own:
+                trace.reset()
+
     def test_cluster_trace_dump_merges_and_loads(self, collector,
                                                  tmp_path):
         c1 = _mk_client(collector.port, "hostA", 0)
@@ -630,6 +817,22 @@ class TestRelayOff:
             assert 'host="' not in body
             assert 'process_index="' not in body
         finally:
+            httpexport.stop()
+
+    def test_broken_metrics_render_falls_back_to_local(self):
+        """A metrics_render provider that raises OR returns a non-str
+        must degrade the scrape to the host-local render, never cost
+        /metrics a 500."""
+        exp = httpexport.start(0)
+        try:
+            for bad in (lambda text: None,
+                        lambda text: (_ for _ in ()).throw(RuntimeError)):
+                httpexport.set_cluster_providers(metrics_render=bad)
+                code, body = _get(exp.url + "/metrics")
+                assert code == 200
+                assert "bst_http_requests_total" in body
+        finally:
+            httpexport.clear_cluster_providers()
             httpexport.stop()
 
     def test_rank0_hosts_and_registers_itself(self, monkeypatch):
