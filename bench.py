@@ -1693,6 +1693,41 @@ def measure_nonrigid_kernel():
     }
 
 
+def measure_tune(xml_path):
+    """The closed telemetry loop as a measured ratio: `bst tune run` over
+    the built-in tiny-fusion workload (1 timed execution per config, hard
+    cap 3) against a scratch history store. baseline/best is >= 1.0 by
+    construction — a candidate must beat the incumbent by min-gain or the
+    default configuration wins with an empty override set — so the value
+    reports how much headroom the autotuner found on this host, never a
+    regression."""
+    from bigstitcher_spark_tpu import tune
+
+    root = os.path.join(FIXTURE, "tune-bench")
+    shutil.rmtree(root, ignore_errors=True)
+    hist = os.path.join(root, "history")
+    os.makedirs(hist, exist_ok=True)
+    wl = tune.resolve_workload("tiny-fusion", os.path.join(root, "work"))
+    res = tune.autotune(wl, force_knobs=("BST_WRITE_THREADS",),
+                        trials_per_config=1, max_trials=3,
+                        history_dir=hist)
+    speedup = res.baseline_seconds / max(res.best_seconds, 1e-9)
+    return {
+        "metric": "tune_speedup_vs_default",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "baseline_s": round(res.baseline_seconds, 3),
+        "best_s": round(res.best_seconds, 3),
+        "trials": len(res.trials),
+        "rules_fired": [d.rule for d in res.diagnoses],
+        "best_overrides": res.best_overrides,
+        "profile_key": res.profile_key,
+        "note": ("bst tune run over the tiny-fusion workload, 1 timed "
+                 "execution per config (cap 3); every trial is a "
+                 "tune-trial history record in the scratch store"),
+    }
+
+
 def _log(msg):
     print(f"[bench:{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
           flush=True)
@@ -1879,6 +1914,7 @@ EXTRA_MEASURES = (
     ("multitp", lambda xml: measure_multitp()),
     ("nonrigid", lambda xml: measure_nonrigid()),
     ("nonrigid_kernel", lambda xml: measure_nonrigid_kernel()),
+    ("tune", lambda xml: measure_tune(xml)),
 )
 
 

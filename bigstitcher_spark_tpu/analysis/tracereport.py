@@ -176,6 +176,18 @@ def _pct(x: float, denom: float) -> float:
     return round(100.0 * x / denom, 1) if denom > 0 else 0.0
 
 
+def analyze(path: str, top: int = 5) -> dict:
+    """The decomposition as DATA: load a trace (file or telemetry dir)
+    and return the :func:`build_report` dict — the machine face of
+    ``bst trace-report`` that `bst tune advise` (and any script) consumes
+    without parsing the rendered table. The report additionally carries
+    the resolved source ``files``."""
+    events, meta = load_events(path)
+    rep = build_report(events, meta, top=top)
+    rep["files"] = meta.get("files", [])
+    return rep
+
+
 def build_report(events: list[dict], meta: dict | None = None,
                  top: int = 5) -> dict:
     intervals, track_names = build_intervals(events)

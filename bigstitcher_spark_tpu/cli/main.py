@@ -21,6 +21,7 @@ from . import (
     solver_tools,
     stitching_tools,
     telemetry_tools,
+    tune_tools,
     utility_tools,
 )
 
@@ -33,7 +34,8 @@ from . import (
 # itself starts its exporter inside Daemon.start().
 _NO_LIVE_EXPORTER = {"serve", "submit", "jobs", "cancel", "top",
                      "trace-dump", "history", "perf-diff", "config",
-                     "env", "lint", "telemetry-merge", "trace-report"}
+                     "env", "lint", "telemetry-merge", "trace-report",
+                     "tune"}
 
 
 @click.group()
@@ -90,6 +92,7 @@ cli.add_command(observe_tools.top_cmd, "top")
 cli.add_command(observe_tools.trace_dump_cmd, "trace-dump")
 cli.add_command(observe_tools.history_cmd, "history")
 cli.add_command(observe_tools.perf_diff_cmd, "perf-diff")
+cli.add_command(tune_tools.tune_cmd, "tune")
 
 
 def main():

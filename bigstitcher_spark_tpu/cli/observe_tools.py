@@ -264,14 +264,28 @@ def history_cmd(ctx):
 
 @history_cmd.command("list")
 @_history_dir_opt
-def history_list_cmd(history_dir):
+@click.option("--tool", default=None,
+              help="only records produced by this tool")
+@click.option("--since", default=None, metavar="STAMP",
+              help="only records at/after this ISO stamp (prefixes "
+                   "work: 2026-08, 2026-08-06T12)")
+@click.option("--limit", type=int, default=None,
+              help="keep only the newest N records (after filters)")
+@click.option("--json", "as_json", is_flag=True,
+              help="machine-readable index entries (stable keys: id, "
+                   "ts, tool, job, status, seconds, file)")
+def history_list_cmd(history_dir, tool, since, limit, as_json):
     """List recorded runs/jobs, oldest first."""
     from ..observe import history
 
     try:
-        entries = history.list_records(history_dir)
+        entries = history.list_records(history_dir, tool=tool,
+                                       since=since, limit=limit)
     except FileNotFoundError as e:
         raise click.ClickException(str(e))
+    if as_json:
+        click.echo(_json.dumps(entries, indent=1, default=str))
+        return
     if not entries:
         click.echo("history is empty (runs record when BST_HISTORY_DIR "
                    "is set; import manifests with `bst history add`)")
@@ -325,26 +339,52 @@ def history_add_cmd(history_dir, path):
 @click.option("--last", "last_n", type=int, default=None,
               help="diff the N-th most recent record against the most "
                    "recent (--last 2 = previous vs latest; RUN_A/RUN_B "
-                   "are then optional)")
+                   "are then optional). Defaults to records of the SAME "
+                   "tool as the latest one — cross-tool deltas compare "
+                   "different workloads")
+@click.option("--tool", default=None,
+              help="restrict --last selection to records of this tool")
 @click.option("--json", "as_json", is_flag=True,
               help="machine-readable diff")
 @click.option("--fail-on-regression", is_flag=True, default=False,
               help="exit 2 when any regression is flagged (CI gate)")
 @click.argument("run_a", required=False)
 @click.argument("run_b", required=False)
-def perf_diff_cmd(history_dir, threshold, last_n, as_json, run_a, run_b,
-                  fail_on_regression):
+def perf_diff_cmd(history_dir, threshold, last_n, tool, as_json, run_a,
+                  run_b, fail_on_regression):
     """Diff two recorded runs: spans, byte counters, cache hit ratios.
 
     RUN_A is the baseline, RUN_B the candidate — ids, unique id
     prefixes, negative indices (-1 = latest) or paths to record/manifest
-    JSON files. `--last 2` compares the two most recent records."""
+    JSON files. `--last 2` compares the two most recent records of the
+    latest record's tool (or of --tool); an explicit RUN_A RUN_B pair
+    from different tools diffs with a warning."""
     from ..observe import history
 
     if last_n is not None:
         if last_n < 2:
             raise click.ClickException("--last wants >= 2 (two runs)")
-        run_a, run_b = str(-last_n), "-1"
+        try:
+            entries = history.list_records(history_dir, tool=tool)
+        except FileNotFoundError as e:
+            raise click.ClickException(str(e))
+        if tool is None and entries:
+            # same-tool by default: a fusion vs a solver record diffs
+            # syntactically but the deltas are nonsense
+            anchor = entries[-1].get("tool")
+            same = [e for e in entries if e.get("tool") == anchor]
+            if len(same) >= last_n:
+                entries = same
+            else:
+                click.echo(
+                    f"warning: only {len(same)} record(s) of tool "
+                    f"{anchor!r} — forcing a CROSS-TOOL diff over the "
+                    f"whole store (pass --tool to pin one)", err=True)
+        if len(entries) < last_n:
+            raise click.ClickException(
+                f"--last {last_n}: only {len(entries)} matching "
+                f"record(s) in the store")
+        run_a, run_b = entries[-last_n]["id"], entries[-1]["id"]
     if not run_a or not run_b:
         raise click.ClickException("need RUN_A and RUN_B (or --last 2)")
     try:
@@ -352,6 +392,10 @@ def perf_diff_cmd(history_dir, threshold, last_n, as_json, run_a, run_b,
         b = history.load_record(run_b, history_dir)
     except (FileNotFoundError, KeyError, IndexError) as e:
         raise click.ClickException(str(e))
+    if a.get("tool") != b.get("tool") and (a.get("tool") or b.get("tool")):
+        click.echo(f"warning: cross-tool diff ({a.get('tool')} vs "
+                   f"{b.get('tool')}) — the deltas compare different "
+                   f"workloads", err=True)
     rep = history.diff(a, b, threshold_pct=threshold)
     if as_json:
         click.echo(_json.dumps(rep, indent=1, default=str))

@@ -127,6 +127,13 @@ def _parse_sets(pairs) -> dict:
                    "mesh and caches, streaming blocks between them "
                    "in-process; TOOL/ARGS become extra `pipeline run` "
                    "flags (e.g. --keep-intermediates)")
+@click.option("--profile", "profile", default=None,
+              metavar="auto|KEY",
+              help="apply a tuned profile from the daemon's history "
+                   "store (`bst tune run` writes them): a profile key / "
+                   "unique prefix, or `auto` to let the daemon pick the "
+                   "best backend/device/shape match; profile knobs apply "
+                   "under any explicit --set")
 @click.option("--follow/--no-follow", default=True,
               help="stream heartbeats and exit with the job's exit code "
                    "(default) vs. return the job id immediately")
@@ -135,7 +142,7 @@ def _parse_sets(pairs) -> dict:
 @click.argument("tool", required=False)
 @click.argument("args", nargs=-1, type=click.UNPROCESSED)
 def submit_cmd(socket_path, priority, share, sets, cost, after,
-               pipeline_spec, follow, quiet, tool, args):
+               pipeline_spec, profile, follow, quiet, tool, args):
     """Submit TOOL [ARGS...] (or --pipeline SPEC) to the serve daemon.
 
     Example: bst submit affine-fusion -o fused.ome.zarr"""
@@ -170,7 +177,7 @@ def submit_cmd(socket_path, priority, share, sets, cost, after,
         resp = client.submit(
             socket_path, tool, list(args), priority=priority, share=share,
             overrides=_parse_sets(sets), cost=cost, after=after_ids,
-            follow=follow, on_event=on_event)
+            profile=profile, follow=follow, on_event=on_event)
     except (OSError, RuntimeError) as e:
         raise click.ClickException(
             f"{e} — is a daemon running? start one with `bst serve`")

@@ -1,0 +1,239 @@
+"""`bst tune` — the telemetry loop's closing arc.
+
+advise: recorded evidence -> structured knob diagnoses.
+run:    diagnoses -> coordinate-descent trials -> a tuned profile.
+list/show/apply: browse the profile store; replay a winner ad hoc.
+
+The daemon side of the loop lives in serve/ (`bst submit --profile
+auto`); this module is the operator-facing face.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import os
+
+import click
+
+from .observe_tools import _history_dir_opt
+
+
+@click.group("tune")
+def tune_cmd():
+    """History-driven performance advisor + knob autotuner."""
+
+
+@tune_cmd.command("advise")
+@_history_dir_opt
+@click.option("--trace", "trace", default=None,
+              type=click.Path(exists=True),
+              help="trace file or telemetry dir to decompose (default: "
+                   "the record's own trace_file pointer, when reachable)")
+@click.option("--json", "as_json", is_flag=True,
+              help="machine-readable diagnoses")
+@click.argument("ref", required=False, default="-1")
+def tune_advise_cmd(history_dir, trace, as_json, ref):
+    """Run the advisor rules over one recorded run.
+
+    REF is a history record (id, unique prefix, a negative index via
+    `-- -2`; default: the latest record) or a path to a manifest/record
+    JSON file. Each fired rule names the evidence, the implicated knob
+    and a suggested value."""
+    from .. import tune
+
+    try:
+        diags, rec = tune.advise(ref, history_dir=history_dir,
+                                 trace=trace)
+    except (FileNotFoundError, KeyError) as e:
+        raise click.ClickException(str(e))
+    if as_json:
+        click.echo(_json.dumps(
+            {"run": rec.get("id") or rec.get("tool"),
+             "tool": rec.get("tool"),
+             "diagnoses": [d.as_dict() for d in diags]},
+            indent=1, default=str))
+    else:
+        click.echo(tune.render(diags, rec))
+
+
+@tune_cmd.command("run")
+@_history_dir_opt
+@click.option("--workload", default="tiny-fusion", show_default=True,
+              help="'tiny-fusion' (the built-in CPU-fallback bench "
+                   "workload) or a `bst pipeline` spec path")
+@click.option("--workdir", default=None, type=click.Path(file_okay=False),
+              help="working directory for workload fixtures + per-trial "
+                   "telemetry (default: <history-dir>/tune-work)")
+@click.option("--trials", type=int, default=2, show_default=True,
+              help="best-of-N timed executions per configuration")
+@click.option("--max-trials", type=int, default=12, show_default=True,
+              help="hard cap on total timed executions")
+@click.option("--min-gain", type=float, default=0.02, show_default=True,
+              help="fractional improvement a candidate must show to "
+                   "displace the incumbent (noise floor)")
+@click.option("--knob", "knobs", multiple=True,
+              help="force this tunable knob into the search even when "
+                   "no advisor rule implicates it (repeatable)")
+@click.option("--no-warmup", is_flag=True, default=False,
+              help="skip the untimed warmup execution")
+@click.option("--no-save", is_flag=True, default=False,
+              help="measure but do not persist a profile")
+@click.option("--json", "as_json", is_flag=True,
+              help="machine-readable tune result")
+def tune_run_cmd(history_dir, workload, workdir, trials, max_trials,
+                 min_gain, knobs, no_warmup, no_save, as_json):
+    """Autotune: baseline the workload, advise on its record, then
+    hill-climb each implicated knob under config.overrides() — every
+    trial lands in the history store (tool `tune-trial`, diffable with
+    `bst perf-diff --tool tune-trial`) and the winner persists as a
+    profile for this backend/device-count/shape."""
+    from .. import tune
+    from ..observe import history
+
+    hist = history.history_dir(history_dir)
+    if hist is None:
+        raise click.ClickException(
+            "tune run needs a history store for trials + profiles: set "
+            "BST_HISTORY_DIR or pass --history-dir")
+    from .. import config
+
+    for k in knobs:
+        if k not in config.tunable_knobs():
+            raise click.ClickException(
+                f"--knob {k}: not a declared-tunable knob (see "
+                f"`bst config --json` for tunable metadata)")
+    try:
+        wl = tune.resolve_workload(
+            workload, workdir or os.path.join(hist, "tune-work"))
+    except (ValueError, FileNotFoundError) as e:
+        raise click.ClickException(str(e))
+    result = tune.autotune(
+        wl, force_knobs=knobs, trials_per_config=trials,
+        max_trials=max_trials, min_gain=min_gain, history_dir=hist,
+        workdir=workdir, warmup=not no_warmup, save=not no_save)
+    if as_json:
+        click.echo(_json.dumps(result.as_dict(), indent=1, default=str))
+        return
+    click.echo(f"workload {result.workload} ({result.shape}) on "
+               f"{result.backend}/{result.device_count}dev: "
+               f"{len(result.trials)} trial(s)")
+    for d in result.diagnoses:
+        click.echo(f"  rule {d.rule} -> "
+                   + (f"{d.knob}={d.suggested_value}" if d.knob
+                      else "(no knob)"))
+    click.echo(f"baseline {result.baseline_seconds:.3f}s -> best "
+               f"{result.best_seconds:.3f}s "
+               f"({result.baseline_seconds / result.best_seconds:.2f}x)"
+               if result.best_seconds else "no successful trials")
+    if result.best_overrides:
+        for k, v in sorted(result.best_overrides.items()):
+            click.echo(f"  {k}={v}")
+    else:
+        click.echo("  default configuration wins (empty override set)")
+    if result.profile_key:
+        click.echo(f"profile saved: {result.profile_key}")
+
+
+def _load_store_or_die(history_dir):
+    from .. import tune
+
+    try:
+        return tune.load_store(history_dir)
+    except FileNotFoundError as e:
+        raise click.ClickException(str(e))
+
+
+def _resolve_profile_or_die(store, ref):
+    from .. import tune
+
+    try:
+        if ref == "auto":
+            backend, ndev = tune.backend_signature()
+            prof = tune.match_profile(store, backend=backend,
+                                      device_count=ndev, ref="auto")
+        else:
+            prof = tune.match_profile(store, backend="", device_count=0,
+                                      ref=ref)
+    except KeyError as e:
+        raise click.ClickException(str(e))
+    if prof is None:
+        raise click.ClickException(
+            f"no profile matching {ref!r} (run `bst tune run` first; "
+            f"`bst tune list` shows the store)")
+    return prof
+
+
+@tune_cmd.command("list")
+@_history_dir_opt
+@click.option("--json", "as_json", is_flag=True,
+              help="machine-readable profile store")
+def tune_list_cmd(history_dir, as_json):
+    """List stored tuned profiles."""
+    store = _load_store_or_die(history_dir)
+    profs = store.get("profiles") or {}
+    if as_json:
+        click.echo(_json.dumps(store, indent=1, default=str))
+        return
+    if not profs:
+        click.echo("no profiles stored (run `bst tune run`)")
+        return
+    for key in sorted(profs):
+        p = profs[key]
+        n_ov = len(p.get("overrides") or {})
+        click.echo(f"{key:<40} {p.get('workload', '?'):<14} "
+                   f"{p.get('speedup', '?')}x  {n_ov} override(s)  "
+                   f"{p.get('created_at', '?')}")
+
+
+@tune_cmd.command("show")
+@_history_dir_opt
+@click.argument("ref")
+def tune_show_cmd(history_dir, ref):
+    """Print one profile (by key, unique key prefix, or `auto` for the
+    best match on this host)."""
+    store = _load_store_or_die(history_dir)
+    prof = _resolve_profile_or_die(store, ref)
+    click.echo(_json.dumps(prof, indent=1, default=str))
+
+
+@tune_cmd.command("apply",
+                  context_settings={"ignore_unknown_options": True,
+                                    "allow_interspersed_args": False})
+@_history_dir_opt
+@click.option("--json", "as_json", is_flag=True,
+              help="machine-readable override set")
+@click.argument("ref")
+@click.argument("tool", required=False)
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
+def tune_apply_cmd(history_dir, as_json, ref, tool, args):
+    """Apply a stored profile: print its override set, or — given a
+    trailing TOOL [ARGS...] — execute that tool in-process under the
+    profile's config.overrides() scope (the ad-hoc spelling of what
+    `bst submit --profile` does through the daemon). Options for `tune
+    apply` itself go BEFORE the profile ref; everything after TOOL is
+    passed through verbatim."""
+    from .. import config, tune
+
+    store = _load_store_or_die(history_dir)
+    prof = _resolve_profile_or_die(store, ref)
+    ov = prof.get("overrides") or {}
+    if tool:
+        from ..tune.workloads import _invoke_cli
+
+        try:
+            with config.overrides(ov):
+                _invoke_cli([tool, *args])
+        except (KeyError, RuntimeError) as e:
+            raise click.ClickException(str(e))
+        return
+    if as_json:
+        click.echo(_json.dumps({"key": prof["key"], "overrides": ov},
+                               indent=1, default=str))
+        return
+    click.echo(f"# profile {prof['key']} "
+               f"(baseline {prof.get('baseline_seconds')}s -> best "
+               f"{prof.get('best_seconds')}s)")
+    if not ov:
+        click.echo("# empty override set: the default configuration won")
+    for k, v in sorted(ov.items()):
+        click.echo(f"{k}={v}")

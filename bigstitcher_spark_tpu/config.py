@@ -46,6 +46,29 @@ _FALSY = frozenset({"0", "false", "no", "off"})
 
 
 @dataclass(frozen=True)
+class Tunable:
+    """Autotune metadata for a knob the ``bst tune`` searcher may move.
+
+    ``lo``/``hi`` bound numeric (int/bytes) knobs; ``scale`` says how the
+    searcher steps between candidates (``pow2`` halves/doubles, ``linear``
+    adds/subtracts ``step``). bool knobs need no bounds (the candidate set
+    is the flip) and str knobs draw candidates from the knob's declared
+    ``choices``. Declaring a Tunable is a statement of SAFETY, not value:
+    every value in range must be performance-only — it may never change
+    job output bytes (tests/test_tune.py asserts this for the profile
+    application path)."""
+
+    lo: int | float | None = None
+    hi: int | float | None = None
+    scale: str = "pow2"
+    step: int | float = 1
+
+    def as_dict(self) -> dict:
+        return {"lo": self.lo, "hi": self.hi, "scale": self.scale,
+                "step": self.step}
+
+
+@dataclass(frozen=True)
 class Knob:
     """One declared ``BST_*`` variable.
 
@@ -56,7 +79,8 @@ class Knob:
     ``bench`` (bench.py / scripts), ``tests`` (the pytest suite) —
     non-runtime knobs are declared so docs, ``bst config`` and the
     doc-drift test cover the whole surface, not because the package reads
-    them."""
+    them. ``tunable`` marks knobs the ``bst tune`` autotuner may search
+    (performance-only knobs with safe kind-aware bounds)."""
 
     name: str
     kind: str
@@ -64,17 +88,23 @@ class Knob:
     doc: str
     consumer: str = "runtime"
     choices: tuple[str, ...] | None = None
+    tunable: Tunable | None = None
 
 
 KNOBS: dict[str, Knob] = {}
 
 
 def _knob(name: str, kind: str, default, doc: str, *,
-          consumer: str = "runtime", choices=None) -> None:
+          consumer: str = "runtime", choices=None, tunable=None) -> None:
     if name in KNOBS:
         raise ValueError(f"knob {name} declared twice")
     KNOBS[name] = Knob(name, kind, default, doc, consumer,
-                       tuple(choices) if choices else None)
+                       tuple(choices) if choices else None, tunable)
+
+
+def tunable_knobs() -> dict[str, Knob]:
+    """The declared-tunable subset of the registry, for `bst tune`."""
+    return {n: k for n, k in KNOBS.items() if k.tunable is not None}
 
 
 # -- IO / caching ----------------------------------------------------------
@@ -83,15 +113,18 @@ _knob("BST_NATIVE_IO", "bool", True,
       "GIL-free reads/writes when built; 0 forces tensorstore.")
 _knob("BST_CHUNK_CACHE_BYTES", "bytes", 1 << 30,
       "Byte budget of the process-wide decoded-chunk LRU cache "
-      "(io/chunkcache.py); 0 disables caching entirely.")
+      "(io/chunkcache.py); 0 disables caching entirely.",
+      tunable=Tunable(lo=64 << 20, hi=16 << 30))
 _knob("BST_TILE_CACHE_BYTES", "bytes", int(2e9),
       "Byte budget of the HBM-resident composite fusion tile cache keyed "
-      "by dataset signature + write generation; 0 disables.")
+      "by dataset signature + write generation; 0 disables.",
+      tunable=Tunable(lo=64 << 20, hi=32 << 30))
 _knob("BST_WRITE_THREADS", "int", 8,
       "Concurrent writer threads for the pipelined device-volume drain "
       "(fusion full-res + epilogue pyramid slabs). ~8 MB slabs over ~8 "
       "streams measured best on the wire-limited link; h5py containers "
-      "always clamp to 1 (single-writer rule).")
+      "always clamp to 1 (single-writer rule).",
+      tunable=Tunable(lo=1, hi=64))
 _knob("BST_S3_REGION", "str", None,
       "Default AWS region for s3:// roots (the reference's --s3Region); "
       "io.uris.set_s3_region() overrides at runtime.")
@@ -104,11 +137,13 @@ _knob("BST_INFLIGHT_BYTES", "bytes", None,
       "Process-wide byte budget for dispatched-but-undrained device work "
       "(utils/devicemem.py). Default: derived from the backend's "
       "memory_stats (60% of free HBM), 2e9 where the runtime reports "
-      "nothing (XLA:CPU).")
+      "nothing (XLA:CPU).",
+      tunable=Tunable(lo=128 << 20, hi=64 << 30))
 _knob("BST_PAIR_INFLIGHT_BYTES", "bytes", None,
       "PER-DEVICE byte budget for a pair stage's in-flight work "
       "(stitching PCM, descriptor/intensity matching). Default: each "
-      "device's own memory_stats-derived budget.")
+      "device's own memory_stats-derived budget.",
+      tunable=Tunable(lo=64 << 20, hi=64 << 30))
 _knob("BST_DEVICE_TILE_BUDGET", "bytes", int(4e9),
       "Device-residency budget for the whole-volume composite fusion "
       "path (tiles + f32 accumulators must fit or the driver falls back "
@@ -118,16 +153,18 @@ _knob("BST_PER_DEV_BUDGET", "bytes", int(1e9),
       "blocks per dispatch (per_dev).")
 _knob("BST_EARLY_DISPATCH", "bool", True,
       "Allow the sharded work loop to dispatch batches ahead of the one "
-      "currently draining; 0 forces strict one-batch-at-a-time.")
+      "currently draining; 0 forces strict one-batch-at-a-time.",
+      tunable=Tunable())
 _knob("BST_PAIR_SHARD", "bool", True,
       "Spread the pair-parallel stages over every local device "
-      "(parallel/pairsched.py); 0 pins them to one device.")
+      "(parallel/pairsched.py); 0 pins them to one device.",
+      tunable=Tunable())
 
 # -- kernels ---------------------------------------------------------------
 _knob("BST_DOG_BLUR", "str", "auto",
       "DoG blur strategy: fft (rfftn transfer multiply, the CPU win) or "
       "gemm (Toeplitz matmuls on the MXU); auto picks per backend.",
-      choices=("auto", "fft", "gemm"))
+      choices=("auto", "fft", "gemm"), tunable=Tunable())
 
 # -- global solvers (ops/solve.py) -----------------------------------------
 _knob("BST_SOLVE_DEVICE", "bool", True,
@@ -202,7 +239,8 @@ _knob("BST_RELAY_QUEUE", "int", 256,
       "Bounded length of the relay client's outbound message queue. A "
       "slow or absent collector fills it and further messages drop (and "
       "count in bst_relay_dropped_total) — the producing rank's hot path "
-      "never blocks on telemetry.")
+      "never blocks on telemetry.",
+      tunable=Tunable(lo=64, hi=8192))
 _knob("BST_HISTORY_DIR", "str", None,
       "Directory of the cross-run manifest history store "
       "(observe/history.py): every finalized run/job manifest appends a "
@@ -229,6 +267,12 @@ _knob("BST_STALL_TIMEOUT_S", "int", 300,
       "flagged `stalled` (bst_serve_jobs_stalled gauge, a job.stall warn "
       "event on its sink, non-200 /healthz) until progress resumes or it "
       "is cancelled. 0 disables the watchdog.")
+_knob("BST_PROFILE_AUTO", "bool", False,
+      "Let the `bst serve` daemon resolve the best matching tuned profile "
+      "(BST_HISTORY_DIR/profiles.json, written by `bst tune run`) for "
+      "every submitted job that does not name one — the always-on "
+      "equivalent of `bst submit --profile auto`. Profile knobs apply "
+      "through per-job config.overrides(), under any explicit --set.")
 
 # -- streaming stage-DAG executor (dag/) -----------------------------------
 _knob("BST_DAG_EXCHANGE_BYTES", "bytes", 256 << 20,
@@ -239,7 +283,8 @@ _knob("BST_DAG_EXCHANGE_BYTES", "bytes", 256 << 20,
       "for unpublished blocks — then the producer always proceeds). "
       "0 disables backpressure. Full in-memory elision additionally "
       "needs BST_CHUNK_CACHE_BYTES >= this budget, or evicted handoff "
-      "chunks fall back to a container decode.")
+      "chunks fall back to a container decode.",
+      tunable=Tunable(lo=32 << 20, hi=8 << 30))
 
 # -- install wrappers ------------------------------------------------------
 _knob("BST_DEVICES", "int", None,
@@ -431,6 +476,7 @@ def resolve() -> list[dict]:
             "kind": k.kind,
             "consumer": k.consumer,
             "doc": k.doc,
+            "tunable": k.tunable.as_dict() if k.tunable else None,
         })
     return out
 

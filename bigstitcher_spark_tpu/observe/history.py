@@ -42,10 +42,13 @@ _seq_lock = threading.Lock()
 _seq = 0
 
 # manifest keys copied into a history record verbatim — the numeric
-# surface perf-diff / bst tune consume, minus the heavyweight pointers
-# (event logs, traces) that stay in the telemetry dir
+# surface perf-diff / bst tune consume, minus the heavyweight event logs
+# that stay in the telemetry dir. trace_file is kept as a POINTER
+# (resolved relative to source_manifest) so `bst tune advise` can reach
+# the flight-recorder decomposition of a recorded run.
 _KEEP = ("tool", "argv", "params", "world", "device", "started_at",
-         "seconds", "status", "error", "spans", "metrics", "stages")
+         "seconds", "status", "error", "spans", "metrics", "stages",
+         "trace_file")
 
 
 def history_dir(override: str | None = None) -> str | None:
@@ -148,9 +151,17 @@ def record_merged_report(report: dict, *, source: str | None = None,
     return _write_record(d, rid, rec)
 
 
-def list_records(directory: str | None = None) -> list[dict]:
+def list_records(directory: str | None = None, *, tool: str | None = None,
+                 since: str | None = None,
+                 limit: int | None = None) -> list[dict]:
     """Index entries, oldest first; [] when the store exists but is
-    empty. Raises FileNotFoundError when no history dir is configured."""
+    empty. Raises FileNotFoundError when no history dir is configured.
+
+    ``tool`` keeps only records of that tool, ``since`` only records
+    whose timestamp is >= the given stamp (ISO timestamps compare
+    lexicographically, so any prefix like "2026-08" works), ``limit``
+    keeps the NEWEST N entries after the other filters (still returned
+    oldest first)."""
     d = history_dir(directory)
     if d is None:
         raise FileNotFoundError(
@@ -167,6 +178,12 @@ def list_records(directory: str | None = None) -> list[dict]:
                     out.append(json.loads(line))
                 except ValueError:
                     continue   # torn line from a crashed writer
+    if tool is not None:
+        out = [e for e in out if e.get("tool") == tool]
+    if since is not None:
+        out = [e for e in out if (e.get("ts") or "") >= since]
+    if limit is not None and limit >= 0:
+        out = out[len(out) - limit:] if limit else []
     return out
 
 

@@ -157,6 +157,14 @@ METRICS: dict[str, str] = {
     "bst_dag_containers_elided_total":
         "ephemeral intermediate containers elided to memory (never "
         "materialized on disk)",
+    # telemetry-loop closer (tune/): advisor rules + autotuner trials +
+    # daemon-side profile application
+    "bst_tune_trials_total":
+        "autotuner trial executions, labeled by workload",
+    "bst_tune_rules_fired_total":
+        "advisor diagnoses emitted, labeled by rule",
+    "bst_tune_profiles_applied_total":
+        "tuned profiles applied to submitted jobs by the serve daemon",
 }
 
 # Every trace/profiling SPAN name, declared exactly once — the same
@@ -238,6 +246,9 @@ SPANS: dict[str, str] = {
     "dag.stall": "a producer stage blocked on block-exchange backpressure",
     "dag.publish": "a producer published an output block (instant)",
     "dag.cleanup": "ephemeral intermediate-container cleanup",
+    # telemetry-loop closer (tune/)
+    "tune.advise": "one advisor pass over a recorded run's evidence",
+    "tune.trial": "one autotuner trial execution under candidate overrides",
 }
 
 

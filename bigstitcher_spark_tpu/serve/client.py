@@ -73,7 +73,7 @@ def shutdown(socket_path: str | None = None, drain: bool = True) -> dict:
 def submit(socket_path: str | None, tool: str, args: list[str],
            *, priority: int = 0, share: str | None = None,
            overrides: dict | None = None, cost: float = 1.0,
-           after: list[str] | None = None,
+           after: list[str] | None = None, profile: str | None = None,
            follow: bool = True, on_event=None,
            timeout: float | None = None) -> dict:
     """Submit one job. ``follow=True`` (default) blocks until the job
@@ -82,16 +82,21 @@ def submit(socket_path: str | None, tool: str, args: list[str],
     ``warm_compile_hits``, ``telemetry_dir``). ``follow=False`` returns
     the ``accepted`` record immediately. ``after`` lists parent job ids:
     the job stays queued until they all succeed and cancels if any of
-    them fails or is cancelled."""
+    them fails or is cancelled. ``profile`` names a tuned profile from
+    the daemon's BST_HISTORY_DIR store (or ``"auto"`` for the best
+    backend/shape match) applied under the job's own overrides."""
     s = protocol.connect(socket_path, timeout=timeout)
     try:
         f = s.makefile("rwb")
-        protocol.send_line(f, {
+        req = {
             "op": "submit", "tool": tool, "args": list(args),
             "priority": priority, "share": share, "cost": cost,
             "overrides": overrides or {}, "follow": follow,
             "after": list(after or []),
-        })
+        }
+        if profile:
+            req["profile"] = profile
+        protocol.send_line(f, req)
         first = protocol.read_line(f)
         if first is None:
             raise OSError("daemon closed the connection without replying")

@@ -54,6 +54,7 @@ _BLOCKED_TOOLS = {"serve", "submit", "jobs", "cancel", "top", "trace-dump"}
 _BLOCKED_FLAGS = {"--telemetry-dir", "--profile", "--trace"}
 
 _WARM_HITS = _metrics.counter("bst_serve_compile_warm_hits_total")
+_PROFILES_APPLIED = _metrics.counter("bst_tune_profiles_applied_total")
 
 # events forwarded to following submit clients (everything else stays in
 # the job's JSONL only — a chatty fusion log must not flood the socket)
@@ -688,6 +689,33 @@ class Daemon:
         except KeyError as e:
             protocol.send_line(f, {"event": "error", "error": str(e)})
             return
+        # tuned-profile application: an explicit `submit --profile` ref,
+        # or BST_PROFILE_AUTO resolving every job against the store. The
+        # profile's knobs merge UNDER the job's own --set overrides (the
+        # operator's explicit word always wins) and the applied key rides
+        # in the job description + manifest params for auditability.
+        prof = None
+        prof_ref = req.get("profile")
+        if prof_ref or config.get_bool("BST_PROFILE_AUTO"):
+            try:
+                prof = self._resolve_profile(str(prof_ref or "auto"))
+            except (KeyError, FileNotFoundError, ValueError) as e:
+                if prof_ref and prof_ref != "auto":
+                    # the client named a specific profile: failing to
+                    # resolve it must not silently run untuned
+                    protocol.send_line(f, {"event": "error",
+                                           "error": str(e)})
+                    return
+                prof = None   # auto is best-effort by design
+        if prof is not None:
+            try:
+                prof_ov = config.validate_overrides(
+                    prof.get("overrides") or {})
+            except KeyError as e:   # store written by a newer/older build
+                protocol.send_line(f, {"event": "error", "error": str(e)})
+                return
+            ov = {**prof_ov, **ov}
+            _PROFILES_APPLIED.inc()
         with self._lock:
             self._job_seq += 1
             jid = f"j{self._job_seq:04d}"
@@ -699,6 +727,8 @@ class Daemon:
             cost=float(req.get("cost") or 1.0),
             after=[str(a) for a in (req.get("after") or [])],
         )
+        if prof is not None:
+            job.profile = prof.get("key")
         job.telemetry_dir = os.path.join(self.jobs_root, jid)
         follow = bool(req.get("follow", True))
         waiter = None
@@ -716,8 +746,11 @@ class Daemon:
         _trace.instant("serve.submit", item=jid)
         events.emit("serve.submit", job=jid, tool=tool, share=job.share,
                     priority=job.priority, after=job.after)
-        protocol.send_line(f, {"event": "accepted", "job": jid,
-                               "telemetry_dir": job.telemetry_dir})
+        accepted = {"event": "accepted", "job": jid,
+                    "telemetry_dir": job.telemetry_dir}
+        if job.profile:
+            accepted["profile"] = job.profile
+        protocol.send_line(f, accepted)
         if job.state == CANCELLED:
             # a parent had already failed/cancelled: terminal on arrival
             self._notify(job, {"event": "done", "job": jid,
@@ -731,6 +764,20 @@ class Daemon:
             protocol.send_line(f, msg)
             if msg.get("event") == "done":
                 return
+
+    def _resolve_profile(self, ref: str) -> dict | None:
+        """Resolve a submit-time profile reference against the tuned-
+        profile store (BST_HISTORY_DIR/profiles.json) along THIS
+        daemon's backend axes. ``auto`` returns None when nothing
+        matches; an explicit ref raises KeyError (handled by the
+        caller into a submit error)."""
+        from ..tune import profiles as _profiles
+
+        store = _profiles.load_store(None)
+        backend = self.device_info.get("platform") or "cpu"
+        ndev = int(self.device_info.get("local_device_count") or 1)
+        return _profiles.match_profile(store, backend=backend,
+                                       device_count=ndev, ref=ref)
 
     # -- job execution -------------------------------------------------------
 
@@ -837,6 +884,7 @@ class Daemon:
                 error=error,
                 params={"tool": job.tool, "args": job.args,
                         "overrides": job.overrides,
+                        "profile": job.profile,
                         "priority": job.priority, "share": job.share,
                         "slot": slot,
                         "warm_compile_hits": job.warm_compile_hits})

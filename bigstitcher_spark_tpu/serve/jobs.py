@@ -85,6 +85,7 @@ class Job:
     seq: int = 0                 # FIFO tiebreak within a share
     slot: int | None = None
     telemetry_dir: str | None = None
+    profile: str | None = None   # tuned-profile key applied to this job
     warm_compile_hits: int = 0
     token: CancelToken = field(default_factory=CancelToken)
     waiters: list = field(default_factory=list)   # queue.Queue per client
@@ -122,6 +123,8 @@ class Job:
             d["error"] = self.error
         if self.telemetry_dir:
             d["telemetry_dir"] = self.telemetry_dir
+        if self.profile:
+            d["profile"] = self.profile
         if self.warm_compile_hits:
             d["warm_compile_hits"] = self.warm_compile_hits
         # snapshot first: the streaming forwarder thread may null this

@@ -11,7 +11,7 @@ Requests::
 
     {"op": "submit", "tool": "...", "args": [...], "priority": 0,
      "share": "...", "overrides": {"BST_X": "..."}, "cost": 1.0,
-     "follow": true, "after": ["j0001"]}
+     "follow": true, "after": ["j0001"], "profile": "auto"}
     {"op": "jobs"}            {"op": "cancel", "job": "..."}
     {"op": "shutdown", "drain": true}        {"op": "ping"}
     {"op": "status"}          {"op": "trace-dump", "out": "path.json"}

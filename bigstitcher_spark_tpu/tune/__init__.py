@@ -1,0 +1,20 @@
+"""Close the telemetry loop: advisor + autotuner + profile store.
+
+The recording substrate (manifests, traces, history, the relay) answers
+"what happened"; this package answers "so what do I change". Three
+pieces, composed by the ``bst tune`` CLI:
+
+- :mod:`advisor` — rules over recorded evidence → structured diagnoses.
+- :mod:`search` — coordinate descent over advisor-implicated knobs,
+  every trial a first-class history record.
+- :mod:`profiles` — winners persisted per (backend, device count,
+  dataset shape) and applied per job by the serve daemon
+  (``bst submit --profile auto`` / ``BST_PROFILE_AUTO``).
+"""
+
+from .advisor import Diagnosis, advise, advise_record, render  # noqa: F401
+from .profiles import (backend_signature, load_store,  # noqa: F401
+                       match_profile, profile_key, save_profile)
+from .search import Trial, TuneResult, autotune  # noqa: F401
+from .workloads import (CallableWorkload, PipelineWorkload,  # noqa: F401
+                        TinyFusionWorkload, resolve_workload)

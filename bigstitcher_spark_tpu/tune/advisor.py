@@ -1,0 +1,333 @@
+"""Rule engine over recorded telemetry: evidence in, knob advice out.
+
+PRs 1/6/13/15 built the recording substrate — manifests, flight-recorder
+traces with the trace-report decomposition, the history store, the pod
+relay — but interpreting any of it stayed a human job: read the overlap
+percentages and cache ratios, then guess which of the declared ``BST_*``
+knobs to turn. This module encodes those readings as explicit rules in
+the performance-portability spirit of SparkCL (PAPERS.md, arXiv
+1505.01120): measure the backend, don't assume it.
+
+Every rule consumes only evidence the substrate already emits (a history
+record's metric deltas + optionally its trace-report decomposition) and
+returns a structured :class:`Diagnosis` — ``{rule, evidence, knob,
+suggested_value, confidence}`` — never a free-form string, so the
+autotuner (tune/search.py) can seed its search from the implicated knobs
+and ``bst tune advise --json`` is scriptable. Rules are deliberately
+conservative: each has a significance floor (a 3-line run with a 40%
+cache miss ratio is noise, not a bottleneck) and fires at most once.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import asdict, dataclass, field
+
+from .. import config, profiling
+from ..analysis import tracereport
+from ..observe import history
+from ..observe import metrics as _metrics
+from ..observe.history import _flat_metrics
+
+# significance floors: below these the evidence is noise, not a signal
+_MIN_CACHE_OPS = 64          # cache lookups before a ratio means anything
+_MIN_COLD_BUILDS = 4         # compiles before cold-start advice fires
+_MIN_CAT_SECONDS = 0.05      # seconds in a trace category worth overlapping
+_OVERLAP_FLOOR_PCT = 40.0    # d2h/write overlap below this is serialized
+_INFLIGHT_SATURATION = 0.92  # high-water / budget ratio that means capped
+_STALL_FRACTION = 0.05       # producer-stall seconds vs wall clock
+
+
+@dataclass
+class Diagnosis:
+    """One fired advisor rule. ``knob`` is None for advice that has no
+    single-knob remedy (e.g. cold compile buckets want a resident
+    daemon, not a value change); ``suggested_value`` is the raw override
+    string ``config.overrides`` accepts."""
+
+    rule: str
+    detail: str
+    confidence: float
+    knob: str | None = None
+    suggested_value: str | None = None
+    evidence: dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+
+def _sum(flat: dict[str, float], base: str) -> float:
+    """Sum a metric over its label variants (``name{label=...}`` keys)."""
+    return sum(v for k, v in flat.items() if k.split("{")[0] == base)
+
+
+def _clamped_double(knob_name: str, current) -> int:
+    k = config.KNOBS[knob_name]
+    v = int(current) if current else int(k.tunable.lo if k.tunable else 1)
+    v = max(1, v) * 2
+    if k.tunable is not None:
+        if k.tunable.lo is not None:
+            v = max(v, int(k.tunable.lo))
+        if k.tunable.hi is not None:
+            v = min(v, int(k.tunable.hi))
+    return v
+
+
+def _recorded_budget(rec: dict, knob_name: str):
+    """The byte budget a recorded run ACTUALLY ran under: its own
+    override (daemon jobs and tune trials record theirs in params) wins;
+    otherwise the advise-time resolved knob; for the in-flight window a
+    last resort asks devicemem for the derived budget (same host ⇒ same
+    derivation; cross-host the evidence dict flags the assumption)."""
+    ov = ((rec.get("params") or {}).get("overrides") or {})
+    raw = ov.get(knob_name)
+    if raw:
+        try:
+            return int(float(raw)), "recorded-override"
+        except (TypeError, ValueError):
+            pass
+    v = config.get_bytes(knob_name)
+    if v is not None:
+        return int(v), "config"
+    if knob_name == "BST_INFLIGHT_BYTES":
+        try:
+            from ..utils import devicemem
+
+            return int(devicemem.dispatch_budget_bytes()), "derived"
+        except Exception:
+            return None, "unavailable"
+    return None, "unavailable"
+
+
+# -- rules ------------------------------------------------------------------
+# each: (record, flat_metrics, trace_report|None, wall_seconds) ->
+# Diagnosis | None
+
+def _rule_low_overlap(rec, flat, trace_rep, wall):
+    if not trace_rep:
+        return None
+    worst = None
+    for group, entry in (trace_rep.get("stages") or {}).items():
+        d2h = float(entry.get("d2h_s") or 0.0)
+        wr = float(entry.get("write_s") or 0.0)
+        if d2h < _MIN_CAT_SECONDS or wr < _MIN_CAT_SECONDS:
+            continue
+        ov = (entry.get("overlap") or {}).get("d2h_write")
+        if not ov:
+            continue
+        pct = float(ov.get("pct_of_d2h") or 0.0)
+        if pct < _OVERLAP_FLOOR_PCT and (worst is None or pct < worst[1]):
+            worst = (group, pct, d2h, wr)
+    if worst is None:
+        return None
+    group, pct, d2h, wr = worst
+    cur = config.get_int("BST_WRITE_THREADS") or 8
+    return Diagnosis(
+        rule="low_d2h_write_overlap",
+        detail=(f"stage {group!r}: only {pct:.0f}% of device-to-host "
+                f"fetch time overlaps container writes ({d2h:.2f}s d2h, "
+                f"{wr:.2f}s write run mostly back-to-back) — more drain "
+                f"writer threads pipeline the two"),
+        confidence=round(min(0.9, 0.4 + (_OVERLAP_FLOOR_PCT - pct) / 100),
+                         2),
+        knob="BST_WRITE_THREADS",
+        suggested_value=str(_clamped_double("BST_WRITE_THREADS", cur)),
+        evidence={"stage": group, "overlap_pct_of_d2h": round(pct, 1),
+                  "d2h_s": round(d2h, 3), "write_s": round(wr, 3)})
+
+
+def _rule_cold_buckets(rec, flat, trace_rep, wall):
+    warm = _sum(flat, "bst_compiled_fn_warm_hits_total")
+    cold = _sum(flat, "bst_compiled_fn_cold_builds_total")
+    if cold < _MIN_COLD_BUILDS or warm + cold <= 0:
+        return None
+    ratio = warm / (warm + cold)
+    if ratio >= 0.5:
+        return None
+    return Diagnosis(
+        rule="cold_compile_buckets",
+        detail=(f"{int(cold)} kernel buckets compiled cold vs "
+                f"{int(warm)} warm hits ({ratio:.0%} warm) — run under a "
+                f"resident `bst serve` daemon (or submit with a tuned "
+                f"profile) so repeat shapes reuse compiled fns"),
+        confidence=round(min(0.9, 0.4 + (0.5 - ratio)), 2),
+        evidence={"cold_builds": int(cold), "warm_hits": int(warm),
+                  "warm_ratio": round(ratio, 3)})
+
+
+def _cache_rule(rule, hits_m, misses_m, evict_m, knob):
+    def _run(rec, flat, trace_rep, wall):
+        hits = _sum(flat, hits_m)
+        misses = _sum(flat, misses_m)
+        evict = _sum(flat, evict_m)
+        total = hits + misses
+        if total < _MIN_CACHE_OPS or evict <= 0:
+            return None
+        ratio = hits / total
+        if ratio >= 0.5:
+            return None
+        cur = config.get_bytes(knob)
+        return Diagnosis(
+            rule=rule,
+            detail=(f"{ratio:.0%} hit ratio over {int(total)} lookups "
+                    f"with {int(evict)} evictions — the working set "
+                    f"does not fit; a larger {knob} stops the thrash"),
+            confidence=round(min(0.9, 0.4 + (0.5 - ratio)), 2),
+            knob=knob,
+            suggested_value=str(_clamped_double(knob, cur)),
+            evidence={"hits": int(hits), "misses": int(misses),
+                      "evictions": int(evict),
+                      "hit_ratio": round(ratio, 3)})
+    return _run
+
+
+_rule_chunk_cache = _cache_rule(
+    "chunk_cache_thrash", "bst_chunk_cache_hits_total",
+    "bst_chunk_cache_misses_total", "bst_chunk_cache_evictions_total",
+    "BST_CHUNK_CACHE_BYTES")
+
+_rule_tile_cache = _cache_rule(
+    "tile_cache_thrash", "bst_tile_cache_hits_total",
+    "bst_tile_cache_misses_total", "bst_tile_cache_evict_bytes_total",
+    "BST_TILE_CACHE_BYTES")
+
+
+def _rule_inflight_saturated(rec, flat, trace_rep, wall):
+    hw = _sum(flat, "bst_inflight_bytes_highwater")
+    if hw <= 0:
+        return None
+    budget, src = _recorded_budget(rec, "BST_INFLIGHT_BYTES")
+    if not budget or hw < _INFLIGHT_SATURATION * budget:
+        return None
+    return Diagnosis(
+        rule="inflight_budget_saturated",
+        detail=(f"in-flight high-water {int(hw)} is "
+                f"{hw / budget:.0%} of the {int(budget)}-byte dispatch "
+                f"window ({src}) — the work loop runs budget-capped; a "
+                f"wider window keeps more batches in flight"),
+        confidence=0.6,
+        knob="BST_INFLIGHT_BYTES",
+        suggested_value=str(_clamped_double("BST_INFLIGHT_BYTES", budget)),
+        evidence={"highwater_bytes": int(hw), "budget_bytes": int(budget),
+                  "budget_source": src,
+                  "saturation": round(hw / budget, 3)})
+
+
+def _rule_dag_backpressure(rec, flat, trace_rep, wall):
+    stall = _sum(flat, "bst_dag_producer_stall_seconds_total")
+    if stall < max(1.0, _STALL_FRACTION * (wall or 0.0)):
+        return None
+    cur = config.get_bytes("BST_DAG_EXCHANGE_BYTES")
+    return Diagnosis(
+        rule="dag_producer_backpressure",
+        detail=(f"streamed-pipeline producers stalled {stall:.1f}s on "
+                f"block-exchange backpressure"
+                + (f" ({stall / wall:.0%} of the {wall:.1f}s wall clock)"
+                   if wall else "")
+                + " — a larger exchange ledger lets producers run ahead"),
+        confidence=round(min(0.9, 0.4 + (stall / wall if wall else 0.2)),
+                         2),
+        knob="BST_DAG_EXCHANGE_BYTES",
+        suggested_value=str(_clamped_double("BST_DAG_EXCHANGE_BYTES", cur)),
+        evidence={"stall_seconds": round(stall, 2),
+                  "wall_seconds": round(wall or 0.0, 2)})
+
+
+def _rule_relay_drops(rec, flat, trace_rep, wall):
+    drops = _sum(flat, "bst_relay_dropped_total")
+    sent = _sum(flat, "bst_relay_sent_total")
+    if drops <= 0:
+        return None
+    cur = config.get_int("BST_RELAY_QUEUE")
+    return Diagnosis(
+        rule="relay_drops",
+        detail=(f"{int(drops)} relay messages dropped"
+                + (f" vs {int(sent)} sent" if sent else "")
+                + " — the collector falls behind this rank; a deeper "
+                "outbound queue absorbs the bursts"),
+        confidence=round(min(0.9, 0.3 + min(0.5, drops / max(sent, 1.0))),
+                         2),
+        knob="BST_RELAY_QUEUE",
+        suggested_value=str(_clamped_double("BST_RELAY_QUEUE", cur)),
+        evidence={"dropped": int(drops), "sent": int(sent)})
+
+
+_RULES = (_rule_low_overlap, _rule_cold_buckets, _rule_chunk_cache,
+          _rule_tile_cache, _rule_inflight_saturated,
+          _rule_dag_backpressure, _rule_relay_drops)
+
+
+def advise_record(rec: dict,
+                  trace_report: dict | None = None) -> list[Diagnosis]:
+    """Run every rule over one history record (or manifest doc) plus its
+    optional trace-report decomposition; returns fired diagnoses sorted
+    by descending confidence."""
+    with profiling.span("tune.advise"):
+        flat = _flat_metrics(rec)
+        wall = float(rec.get("seconds") or 0.0)
+        out: list[Diagnosis] = []
+        for rule in _RULES:
+            d = rule(rec, flat, trace_report, wall)
+            if d is not None:
+                _metrics.counter("bst_tune_rules_fired_total",
+                                 rule=d.rule).inc()
+                out.append(d)
+        out.sort(key=lambda d: -d.confidence)
+        return out
+
+
+def resolve_evidence(ref: str, *, history_dir: str | None = None,
+                     trace: str | None = None
+                     ) -> tuple[dict, dict | None, str | None]:
+    """Load the evidence behind a reference: the history record (or a
+    manifest file), plus the trace-report decomposition when the record
+    points at a reachable trace (``trace`` overrides the pointer)."""
+    rec = history.load_record(ref, history_dir)
+    trace_path = trace
+    if trace_path is None:
+        tf = rec.get("trace_file")
+        if tf:
+            if os.path.isabs(tf):
+                trace_path = tf
+            else:
+                base = rec.get("source_manifest")
+                if base is None and os.path.exists(ref):
+                    base = os.path.abspath(ref)
+                if base:
+                    trace_path = os.path.join(
+                        os.path.dirname(os.path.abspath(base)), tf)
+    trace_rep = None
+    if trace_path and os.path.exists(trace_path):
+        try:
+            trace_rep = tracereport.analyze(trace_path)
+        except (OSError, ValueError):
+            trace_rep = None
+    return rec, trace_rep, trace_path
+
+
+def advise(ref: str, *, history_dir: str | None = None,
+           trace: str | None = None) -> tuple[list[Diagnosis], dict]:
+    """``bst tune advise``'s engine: resolve evidence, run the rules."""
+    rec, trace_rep, _ = resolve_evidence(ref, history_dir=history_dir,
+                                         trace=trace)
+    return advise_record(rec, trace_rep), rec
+
+
+def render(diags: list[Diagnosis], rec: dict | None = None) -> str:
+    """Human table for ``bst tune advise``."""
+    lines = []
+    if rec is not None:
+        lines.append(f"run {rec.get('id') or rec.get('tool')} "
+                     f"({rec.get('tool')}, {rec.get('seconds')}s, "
+                     f"status {rec.get('status')})")
+    if not diags:
+        lines.append("no rules fired — the recorded run shows no "
+                     "bottleneck the advisor recognizes")
+        return "\n".join(lines)
+    lines.append(f"{len(diags)} rule(s) fired:")
+    for d in diags:
+        knob = (f"{d.knob}={d.suggested_value}" if d.knob
+                else "(no single knob)")
+        lines.append(f"  [{d.confidence:4.2f}] {d.rule:<26} -> {knob}")
+        lines.append(f"         {d.detail}")
+    return "\n".join(lines)

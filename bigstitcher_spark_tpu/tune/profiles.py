@@ -1,0 +1,141 @@
+"""Tuned-profile store: winning knob sets keyed by what they were won on.
+
+A tuned configuration is only portable along the axes it was measured
+on — SparkCL's core observation (PAPERS.md, arXiv 1505.01120): the same
+kernel wants different shapes per backend. So a profile is keyed by
+``backend/device_count/shape-signature`` and lives next to the history
+records that justified it, in ``BST_HISTORY_DIR/profiles.json``.
+
+Consumers: ``bst tune list|show|apply`` browse and print; the ``bst
+serve`` daemon resolves ``submit --profile auto`` (or the
+``BST_PROFILE_AUTO`` knob) against this store and applies the winner's
+overrides through ``config.overrides()`` — per job, never the process
+environment, the same isolation mechanism every daemon job already uses.
+Writes are atomic whole-file replaces (profiles are few and small;
+last-writer-wins is acceptable where the index.jsonl's O_APPEND
+interleaving is not).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ..observe import history
+
+SCHEMA = "bst-tune-profiles/1"
+
+
+def profiles_path(directory: str | None = None) -> str | None:
+    d = history.history_dir(directory)
+    return os.path.join(d, "profiles.json") if d else None
+
+
+def profile_key(backend: str, device_count: int, shape: str) -> str:
+    return f"{backend}/{int(device_count)}/{shape}"
+
+
+def backend_signature() -> tuple[str, int]:
+    """(backend platform, local device count) of THIS process — the
+    match axes a tuned profile is valid along. Falls back to ("cpu", 1)
+    when no accelerator runtime is importable (the jax-free bench
+    parent, a bare client host)."""
+    try:
+        import jax
+
+        return jax.default_backend(), jax.local_device_count()
+    except Exception:
+        return "cpu", 1
+
+
+def load_store(directory: str | None = None) -> dict:
+    """The whole store; an empty one when the file does not exist yet.
+    Raises FileNotFoundError when no history dir is configured at all."""
+    path = profiles_path(directory)
+    if path is None:
+        raise FileNotFoundError(
+            "no history dir: set BST_HISTORY_DIR or pass --history-dir")
+    if not os.path.exists(path):
+        return {"schema": SCHEMA, "profiles": {}}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    doc.setdefault("schema", SCHEMA)
+    doc.setdefault("profiles", {})
+    return doc
+
+
+def make_profile(*, backend: str, device_count: int, shape: str,
+                 workload: str, overrides: dict[str, str],
+                 baseline_seconds: float, best_seconds: float,
+                 trials: int, source: str = "tune-run") -> dict:
+    return {
+        "key": profile_key(backend, device_count, shape),
+        "backend": backend,
+        "device_count": int(device_count),
+        "shape": shape,
+        "workload": workload,
+        "overrides": dict(overrides),
+        "baseline_seconds": round(float(baseline_seconds), 4),
+        "best_seconds": round(float(best_seconds), 4),
+        "speedup": round(float(baseline_seconds) / float(best_seconds), 4)
+        if best_seconds else None,
+        "trials": int(trials),
+        "source": source,
+        "created_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+
+def save_profile(profile: dict, directory: str | None = None) -> str:
+    """Insert/replace the profile under its key; returns the key. The
+    write is an atomic whole-file replace."""
+    path = profiles_path(directory)
+    if path is None:
+        raise FileNotFoundError(
+            "no history dir: set BST_HISTORY_DIR or pass --history-dir")
+    store = load_store(directory)
+    key = profile.get("key") or profile_key(
+        profile["backend"], profile["device_count"], profile["shape"])
+    profile = {**profile, "key": key}
+    store["profiles"][key] = profile
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(store, f, indent=1, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+    return key
+
+
+def match_profile(store: dict, *, backend: str, device_count: int,
+                  shape: str | None = None,
+                  ref: str = "auto") -> dict | None:
+    """Resolve a submit-time profile reference.
+
+    ``ref="auto"``: exact (backend, device_count, shape) key first, then
+    the newest profile tuned on the same backend + device count (shape
+    drifts between datasets; the backend axes do not). Anything else is
+    an explicit key or unique key prefix — explicit requests never fall
+    back silently (KeyError instead), because the operator named a
+    specific profile."""
+    profs: dict[str, dict] = store.get("profiles") or {}
+    if ref and ref != "auto":
+        if ref in profs:
+            return profs[ref]
+        hits = [p for k, p in profs.items() if k.startswith(ref)]
+        if len(hits) == 1:
+            return hits[0]
+        if hits:
+            raise KeyError(f"profile ref {ref!r} is ambiguous: "
+                           f"{sorted(p['key'] for p in hits)[:5]}")
+        raise KeyError(f"no profile matching {ref!r}")
+    if shape:
+        exact = profs.get(profile_key(backend, device_count, shape))
+        if exact is not None:
+            return exact
+    same_axes = [p for p in profs.values()
+                 if p.get("backend") == backend
+                 and int(p.get("device_count") or 0) == int(device_count)]
+    if not same_axes:
+        return None
+    return max(same_axes, key=lambda p: p.get("created_at") or "")

@@ -1,0 +1,300 @@
+"""Coordinate-descent knob autotuner over advisor-implicated knobs.
+
+The search space is deliberately small: only knobs the advisor implicated
+(or the operator forced with ``--knob``) are searched, each within its
+declared ``Tunable`` bounds, by hill-climbing from the advisor's
+suggested value (pow2 or linear steps per the metadata). Every timed
+execution — a *trial* — runs the workload IN-PROCESS under
+``config.overrides(candidate)``: the same contextvars isolation layer
+daemon jobs use, never the process environment (the env-mutation lint
+ban stays load-bearing here). Each trial is wrapped in an
+:class:`observe.JobRun`, so it lands in the history store as a first-
+class record (tool ``tune-trial``) and ``bst perf-diff`` works on trials
+exactly like on production runs.
+
+The winner can never regress the default: the baseline configuration is
+measured with the same best-of-N protocol first, and a candidate only
+displaces it by beating it by ``min_gain`` — ties and noise keep the
+empty override set.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import os
+import time
+from dataclasses import asdict, dataclass, field
+
+from .. import config, observe, profiling
+from ..observe import history
+from ..observe import metrics as _metrics
+from . import advisor as _advisor
+from . import profiles as _profiles
+
+
+@dataclass
+class Trial:
+    """One timed workload execution under one override set."""
+
+    n: int
+    overrides: dict
+    seconds: float
+    record_id: str | None = None
+    status: str = "ok"
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class TuneResult:
+    workload: str
+    shape: str
+    backend: str
+    device_count: int
+    baseline_seconds: float
+    best_seconds: float
+    best_overrides: dict
+    trials: list[Trial] = field(default_factory=list)
+    diagnoses: list = field(default_factory=list)
+    profile_key: str | None = None
+
+    def as_dict(self) -> dict:
+        return {
+            "workload": self.workload, "shape": self.shape,
+            "backend": self.backend, "device_count": self.device_count,
+            "baseline_seconds": round(self.baseline_seconds, 4),
+            "best_seconds": round(self.best_seconds, 4),
+            "speedup": round(self.baseline_seconds / self.best_seconds, 4)
+            if self.best_seconds else None,
+            "best_overrides": dict(self.best_overrides),
+            "trials": [t.as_dict() for t in self.trials],
+            "diagnoses": [d.as_dict() for d in self.diagnoses],
+            "profile_key": self.profile_key,
+        }
+
+
+def _current_raw(name: str) -> str | None:
+    """The resolved knob value as the raw override string it would take
+    to pin it there."""
+    v = config.get(name)
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    return str(v)
+
+
+def _step_value(knob: config.Knob, raw: str | None,
+                direction: int) -> str | None:
+    """One tunable step up/down from ``raw``; None at a bound (or for
+    non-numeric kinds, which enumerate choices instead of walking)."""
+    t = knob.tunable
+    if t is None or knob.kind not in ("int", "bytes"):
+        return None
+    try:
+        v = int(float(raw)) if raw is not None else None
+    except (TypeError, ValueError):
+        v = None
+    if v is None or v <= 0:
+        v = int(t.lo) if t.lo else 1
+        return str(v) if direction > 0 else None
+    if t.scale == "linear":
+        nv = v + direction * int(t.step or 1)
+    else:
+        nv = v * 2 if direction > 0 else v // 2
+    if t.lo is not None:
+        nv = max(nv, int(t.lo))
+    if t.hi is not None:
+        nv = min(nv, int(t.hi))
+    return str(nv) if nv > 0 and nv != v else None
+
+
+def _discrete_candidates(knob: config.Knob,
+                         base_raw: str | None) -> list[str]:
+    if knob.kind == "bool":
+        cur = (base_raw or ("1" if knob.default else "0"))
+        truthy = cur.strip().lower() not in config._FALSY
+        return ["0" if truthy else "1"]
+    if knob.choices:
+        return [c for c in knob.choices if c != base_raw]
+    return []
+
+
+def autotune(workload, *, diagnoses=None, force_knobs=(),
+             trials_per_config: int = 2, max_trials: int = 12,
+             min_gain: float = 0.02, history_dir: str | None = None,
+             workdir: str | None = None, warmup: bool = True,
+             save: bool = True) -> TuneResult:
+    """Tune ``workload``: measure the baseline, advise on it (unless
+    ``diagnoses`` is given), hill-climb each implicated knob, and — with
+    ``save`` — persist the winner as a profile for this (backend,
+    device count, workload shape).
+
+    ``max_trials`` caps total timed executions; the baseline is always
+    fully measured, and the search stops early once the remaining budget
+    cannot fit another best-of-``trials_per_config`` configuration."""
+    workdir = os.path.abspath(workdir or os.path.join(
+        history.history_dir(history_dir) or ".", "tune-work"))
+    os.makedirs(workdir, exist_ok=True)
+    trials_per_config = max(1, int(trials_per_config))
+    max_trials = max(trials_per_config, int(max_trials))
+
+    scope = {"BST_HISTORY_DIR": history_dir} if history_dir else {}
+    with config.overrides(scope):
+        return _autotune_inner(workload, diagnoses, force_knobs,
+                               trials_per_config, max_trials, min_gain,
+                               workdir, warmup, save)
+
+
+def _last_record_id() -> str | None:
+    try:
+        entries = history.list_records(None, tool="tune-trial", limit=1)
+    except FileNotFoundError:
+        return None
+    return entries[-1]["id"] if entries else None
+
+
+def _autotune_inner(workload, diagnoses, force_knobs, trials_per_config,
+                    max_trials, min_gain, workdir, warmup,
+                    save) -> TuneResult:
+    trials: list[Trial] = []
+
+    def budget_left() -> int:
+        return max_trials - len(trials)
+
+    def measure(cfg: dict[str, str], label: str) -> float:
+        """Best-of-N timed executions under ``cfg``; each execution is a
+        history-recorded trial. A crashing CANDIDATE reads as infinitely
+        slow (the search simply never adopts it); a crashing baseline
+        aborts the tune."""
+        best = math.inf
+        for _ in range(trials_per_config):
+            n = len(trials) + 1
+            t_dir = os.path.join(workdir, "trials", f"{n:03d}")
+            os.makedirs(t_dir, exist_ok=True)
+            _metrics.counter("bst_tune_trials_total",
+                             workload=workload.name).inc()
+            status, err = "ok", None
+            with config.overrides(cfg):
+                with profiling.span("tune.trial", stage=workload.name,
+                                    item=n):
+                    jr = observe.JobRun(f"tune-{n:03d}", t_dir,
+                                        tool="tune-trial")
+                    t0 = time.perf_counter()
+                    try:
+                        # workload chatter goes to the trial's own
+                        # output.log (the daemon's per-job idiom), so
+                        # `bst tune run --json` stays machine-readable
+                        with open(os.path.join(t_dir, "output.log"), "w",
+                                  encoding="utf-8") as lf, \
+                                contextlib.redirect_stdout(lf), \
+                                contextlib.redirect_stderr(lf):
+                            with jr:
+                                workload.run()
+                    except Exception as e:   # noqa: BLE001 — see docstring
+                        status, err = "error", repr(e)
+                    dt = time.perf_counter() - t0
+                    jr.finalize(status=status, error=err,
+                                params={"trial": n, "config": label,
+                                        "workload": workload.name,
+                                        "overrides": dict(cfg)},
+                                argv=["tune-trial", workload.name])
+            rid = _last_record_id()
+            trials.append(Trial(n=n, overrides=dict(cfg),
+                                seconds=round(dt, 4), record_id=rid,
+                                status=status))
+            if status == "ok":
+                best = min(best, dt)
+        if math.isinf(best) and label == "baseline":
+            raise RuntimeError(
+                f"workload {workload.name!r} failed under the default "
+                f"configuration: {err}")
+        return best
+
+    with open(os.path.join(workdir, "setup.log"), "w",
+              encoding="utf-8") as lf, \
+            contextlib.redirect_stdout(lf), contextlib.redirect_stderr(lf):
+        workload.setup()
+        if warmup:
+            # one untimed, unrecorded execution: page cache + jit warmup
+            # so the baseline is not penalized for going first
+            workload.run()
+
+    baseline_s = measure({}, "baseline")
+    if diagnoses is None:
+        rec_id = _last_record_id()
+        rec = history.load_record(rec_id) if rec_id else None
+        diagnoses = _advisor.advise_record(rec) if rec else []
+
+    tunables = config.tunable_knobs()
+    targets: list[tuple[str, str | None]] = []
+    seen = set()
+    for name in force_knobs:
+        if name in tunables and name not in seen:
+            targets.append((name, None))
+            seen.add(name)
+    for d in diagnoses:
+        if d.knob and d.knob in tunables and d.knob not in seen:
+            targets.append((d.knob, d.suggested_value))
+            seen.add(d.knob)
+
+    best_cfg: dict[str, str] = {}
+    best_s = baseline_s
+    for name, seed in targets:
+        if budget_left() < trials_per_config:
+            break
+        knob = tunables[name]
+        base_raw = best_cfg.get(name, _current_raw(name))
+        tried = {base_raw}
+        if knob.kind in ("int", "bytes"):
+            start = seed if (seed and seed not in tried) \
+                else _step_value(knob, base_raw, +1)
+            if start is None or start in tried:
+                continue
+            s = measure({**best_cfg, name: start}, name)
+            tried.add(start)
+            knob_best: tuple[str, float] | None = \
+                (start, s) if s < best_s else None
+            for direction in (+1, -1):
+                v, vs = start, s
+                while budget_left() >= trials_per_config:
+                    nv = _step_value(knob, v, direction)
+                    if nv is None or nv in tried:
+                        break
+                    ns = measure({**best_cfg, name: nv}, name)
+                    tried.add(nv)
+                    if ns < vs:
+                        v, vs = nv, ns
+                        if knob_best is None or ns < knob_best[1]:
+                            knob_best = (nv, ns)
+                    else:
+                        break
+            if knob_best and knob_best[1] < best_s * (1 - min_gain):
+                best_cfg = {**best_cfg, name: knob_best[0]}
+                best_s = knob_best[1]
+        else:
+            for cand in _discrete_candidates(knob, base_raw):
+                if budget_left() < trials_per_config:
+                    break
+                s = measure({**best_cfg, name: cand}, name)
+                if s < best_s * (1 - min_gain):
+                    best_cfg = {**best_cfg, name: cand}
+                    best_s = s
+
+    backend, n_dev = _profiles.backend_signature()
+    result = TuneResult(
+        workload=workload.name, shape=workload.shape,
+        backend=backend, device_count=n_dev,
+        baseline_seconds=baseline_s, best_seconds=best_s,
+        best_overrides=best_cfg, trials=trials,
+        diagnoses=list(diagnoses))
+    if save:
+        prof = _profiles.make_profile(
+            backend=backend, device_count=n_dev, shape=workload.shape,
+            workload=workload.name, overrides=best_cfg,
+            baseline_seconds=baseline_s, best_seconds=best_s,
+            trials=len(trials))
+        result.profile_key = _profiles.save_profile(prof)
+    return result

@@ -1,0 +1,131 @@
+"""Repeatable workloads the autotuner can time.
+
+A tuning trial needs a workload that (a) runs entirely in-process so
+``config.overrides()`` reaches it (the env-mutation lint ban stays —
+trials must never leak configuration into the process environment), and
+(b) is idempotent under re-execution so N trials measure configuration,
+not state drift. Three shapes cover the surface:
+
+- ``tiny-fusion`` — the built-in CPU-fallback bench workload: a small
+  synthetic project fused through the real CLI path (container create
+  once, ``affine-fusion`` per trial, overwriting the same chunks).
+- a pipeline-spec path (``*.json``) — replays a ``bst pipeline`` spec,
+  so a production pipeline tunes on its own definition.
+- :class:`CallableWorkload` — any python callable; the test suite's
+  synthetic knob-response workloads use this.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _invoke_cli(args: list[str]) -> None:
+    """Run a CLI tool in-process (the daemon's execution idiom): the
+    ambient config.overrides scope applies, no subprocess fork, and a
+    nonzero exit raises instead of killing the tuner."""
+    import click
+
+    from ..cli.main import cli as _cli
+
+    try:
+        _cli(args=args, prog_name="bst", standalone_mode=False)
+    except click.exceptions.Exit as e:
+        if e.exit_code != 0:
+            raise RuntimeError(f"bst {args[0]} exited {e.exit_code}")
+    except SystemExit as e:
+        if e.code not in (0, None):
+            raise RuntimeError(f"bst {args[0]} exited {e.code}")
+
+
+class CallableWorkload:
+    """Wrap any zero-arg callable as a workload (tests, ad-hoc tuning)."""
+
+    def __init__(self, name: str, fn, shape: str = "synthetic"):
+        self.name = name
+        self.shape = shape
+        self._fn = fn
+
+    def setup(self) -> None:
+        pass
+
+    def run(self) -> None:
+        self._fn()
+
+
+class TinyFusionWorkload:
+    """The CPU-fallback bench workload: synthetic tiles fused through
+    the real container path. ``setup`` builds the project + fusion
+    container once; every ``run`` re-executes ``affine-fusion`` into the
+    same container (same chunks, deterministic bytes)."""
+
+    name = "tiny-fusion"
+
+    def __init__(self, workdir: str, *, n_tiles=(2, 2, 1),
+                 tile_size=(64, 64, 32), overlap=16, n_beads_per_tile=20):
+        self.workdir = os.path.abspath(workdir)
+        self.n_tiles = tuple(n_tiles)
+        self.tile_size = tuple(tile_size)
+        self.overlap = overlap
+        self.n_beads = n_beads_per_tile
+        self.shape = ("t" + "x".join(map(str, self.n_tiles))
+                      + "-s" + "x".join(map(str, self.tile_size))
+                      + f"-o{overlap}")
+        self._ready = False
+
+    @property
+    def _proj(self) -> str:
+        return os.path.join(self.workdir, "proj")
+
+    @property
+    def _out(self) -> str:
+        return os.path.join(self.workdir, "fused.ome.zarr")
+
+    def setup(self) -> None:
+        if self._ready:
+            return
+        from ..utils.testdata import make_synthetic_project
+
+        os.makedirs(self.workdir, exist_ok=True)
+        if not os.path.exists(os.path.join(self._proj, "dataset.xml")):
+            make_synthetic_project(
+                self._proj, n_tiles=self.n_tiles,
+                tile_size=self.tile_size, overlap=self.overlap,
+                jitter=0.0, n_beads_per_tile=self.n_beads)
+        _invoke_cli(["create-fusion-container",
+                     "-x", os.path.join(self._proj, "dataset.xml"),
+                     "-o", self._out, "-s", "ZARR", "-d", "UINT16",
+                     "--minIntensity", "0", "--maxIntensity", "65535"])
+        self._ready = True
+
+    def run(self) -> None:
+        self.setup()
+        _invoke_cli(["affine-fusion", "-o", self._out])
+
+
+class PipelineWorkload:
+    """Replay a ``bst pipeline`` spec file per trial — a production
+    pipeline tunes against its own definition."""
+
+    def __init__(self, spec_path: str):
+        self.spec = os.path.abspath(spec_path)
+        self.name = f"pipeline-{os.path.basename(spec_path)}"
+        self.shape = f"pipeline-{os.path.basename(spec_path)}"
+
+    def setup(self) -> None:
+        if not os.path.exists(self.spec):
+            raise FileNotFoundError(self.spec)
+
+    def run(self) -> None:
+        _invoke_cli(["pipeline", "run", self.spec])
+
+
+def resolve_workload(spec: str, workdir: str):
+    """``--workload`` resolution: the built-in ``tiny-fusion`` bench
+    workload, or a path to a pipeline spec JSON."""
+    if spec == "tiny-fusion":
+        return TinyFusionWorkload(os.path.join(workdir, "tiny-fusion"))
+    if spec.endswith(".json") or os.path.exists(spec):
+        return PipelineWorkload(spec)
+    raise ValueError(f"unknown workload {spec!r} — expected 'tiny-fusion' "
+                     f"or a pipeline spec path")

@@ -35,7 +35,8 @@ def test_installer_covers_every_cli_tool(installed_bin):
                "jobs": "bst-jobs", "cancel": "bst-cancel",
                "pipeline": "bst-pipeline",
                "top": "bst-top", "trace-dump": "bst-trace-dump",
-               "history": "bst-history", "perf-diff": "bst-perf-diff"}
+               "history": "bst-history", "perf-diff": "bst-perf-diff",
+               "tune": "bst-tune"}
     expected = {renamed.get(t, t) for t in set(cli.commands)}
     missing = expected - wrappers
     assert not missing, f"installer missing wrappers for: {sorted(missing)}"
@@ -75,3 +76,9 @@ def test_live_observe_wrappers(installed_bin):
         w = installed_bin / name
         assert os.access(w, os.X_OK), name
         assert re.search(rf"cli\.main {tool}", w.read_text()), name
+
+
+def test_tune_wrapper(installed_bin):
+    w = installed_bin / "bst-tune"
+    assert os.access(w, os.X_OK)
+    assert re.search(r"cli\.main tune", w.read_text())

@@ -1,0 +1,739 @@
+"""Closing the telemetry loop: `bst tune` — the history-driven advisor,
+the knob autotuner, the per-shape profile store, and the serve daemon's
+`submit --profile` application path.
+
+Advisor tests plant exactly ONE known bottleneck per record and assert
+exactly that rule fires (and that a healthy record fires none) — the
+rules' significance floors are load-bearing, not decoration. Autotuner
+tests use synthetic workloads with a KNOWN optimal knob value, so
+convergence is a correctness assertion, not a benchmark. The daemon test
+asserts the acceptance contract end to end: a profile applied via
+``config.overrides()`` changes only performance knobs, so job outputs
+stay byte-identical."""
+
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from bigstitcher_spark_tpu import config, tune
+from bigstitcher_spark_tpu.cli.main import cli
+from bigstitcher_spark_tpu.observe import history
+from bigstitcher_spark_tpu.tune import profiles
+
+
+def _cli_ok(runner, args):
+    r = runner.invoke(cli, args, catch_exceptions=False)
+    assert r.exit_code == 0, f"bst {' '.join(args)}\n{r.output}"
+    return r
+
+
+def _json_tail(output: str):
+    """Parse the JSON document at the end of mixed CLI output (warnings
+    ride on stderr but CliRunner merges streams)."""
+    start = min(i for i in (output.find("{"), output.find("["))
+                if i >= 0)
+    return json.loads(output[start:])
+
+
+# a run with NO recognizable bottleneck: high cache ratios, no
+# evictions, warm compiles, no stalls/drops/saturation
+def _healthy_record(**updates) -> dict:
+    rec = {
+        "id": "test-rec", "tool": "affine-fusion", "seconds": 10.0,
+        "status": "ok", "params": {},
+        "metrics": {
+            "bst_chunk_cache_hits_total": 90.0,
+            "bst_chunk_cache_misses_total": 10.0,
+            "bst_chunk_cache_evictions_total": 0.0,
+            "bst_tile_cache_hits_total": 90.0,
+            "bst_tile_cache_misses_total": 10.0,
+            "bst_tile_cache_evict_bytes_total": 0.0,
+            "bst_compiled_fn_warm_hits_total": 50.0,
+            "bst_compiled_fn_cold_builds_total": 2.0,
+        },
+    }
+    rec["metrics"].update(updates.pop("metrics", {}))
+    rec.update(updates)
+    return rec
+
+
+class TestAdvisorRules:
+    def test_healthy_record_fires_nothing(self):
+        assert tune.advise_record(_healthy_record()) == []
+
+    def test_chunk_cache_thrash(self):
+        rec = _healthy_record(metrics={
+            "bst_chunk_cache_hits_total": 10.0,
+            "bst_chunk_cache_misses_total": 90.0,
+            "bst_chunk_cache_evictions_total": 40.0})
+        diags = tune.advise_record(rec)
+        assert [d.rule for d in diags] == ["chunk_cache_thrash"]
+        d = diags[0]
+        assert d.knob == "BST_CHUNK_CACHE_BYTES"
+        assert int(d.suggested_value) > config.get_bytes(
+            "BST_CHUNK_CACHE_BYTES")
+        assert d.evidence["evictions"] == 40
+
+    def test_tile_cache_thrash(self):
+        rec = _healthy_record(metrics={
+            "bst_tile_cache_hits_total": 5.0,
+            "bst_tile_cache_misses_total": 95.0,
+            "bst_tile_cache_evict_bytes_total": 1e9})
+        diags = tune.advise_record(rec)
+        assert [d.rule for d in diags] == ["tile_cache_thrash"]
+        assert diags[0].knob == "BST_TILE_CACHE_BYTES"
+
+    def test_labeled_metric_variants_sum(self):
+        # the store flattens counters to name{label=...} keys; rules must
+        # sum the variants, not miss them
+        rec = _healthy_record(metrics={
+            "bst_chunk_cache_hits_total": 0.0,
+            "bst_chunk_cache_hits_total{store=a}": 5.0,
+            "bst_chunk_cache_hits_total{store=b}": 5.0,
+            "bst_chunk_cache_misses_total": 90.0,
+            "bst_chunk_cache_evictions_total{store=a}": 12.0})
+        diags = tune.advise_record(rec)
+        assert [d.rule for d in diags] == ["chunk_cache_thrash"]
+        assert diags[0].evidence["hits"] == 10
+
+    def test_cold_compile_buckets(self):
+        rec = _healthy_record(metrics={
+            "bst_compiled_fn_warm_hits_total": 1.0,
+            "bst_compiled_fn_cold_builds_total": 8.0})
+        diags = tune.advise_record(rec)
+        assert [d.rule for d in diags] == ["cold_compile_buckets"]
+        # no single knob fixes cold starts — the advice is the daemon
+        assert diags[0].knob is None
+        assert "serve" in diags[0].detail
+
+    def test_few_cold_builds_is_not_advice(self):
+        rec = _healthy_record(metrics={
+            "bst_compiled_fn_warm_hits_total": 0.0,
+            "bst_compiled_fn_cold_builds_total": 3.0})
+        assert tune.advise_record(rec) == []
+
+    def test_inflight_saturated_uses_recorded_budget(self):
+        rec = _healthy_record(
+            params={"overrides": {"BST_INFLIGHT_BYTES": "1000000"}},
+            metrics={"bst_inflight_bytes_highwater": 950000.0})
+        diags = tune.advise_record(rec)
+        assert [d.rule for d in diags] == ["inflight_budget_saturated"]
+        d = diags[0]
+        assert d.knob == "BST_INFLIGHT_BYTES"
+        assert d.evidence["budget_source"] == "recorded-override"
+        assert int(d.suggested_value) > 1000000
+
+    def test_inflight_below_saturation_is_quiet(self):
+        rec = _healthy_record(
+            params={"overrides": {"BST_INFLIGHT_BYTES": "1000000"}},
+            metrics={"bst_inflight_bytes_highwater": 500000.0})
+        assert tune.advise_record(rec) == []
+
+    def test_dag_backpressure(self):
+        rec = _healthy_record(metrics={
+            "bst_dag_producer_stall_seconds_total": 2.0})
+        diags = tune.advise_record(rec)
+        assert [d.rule for d in diags] == ["dag_producer_backpressure"]
+        assert diags[0].knob == "BST_DAG_EXCHANGE_BYTES"
+
+    def test_small_stall_is_quiet(self):
+        rec = _healthy_record(metrics={
+            "bst_dag_producer_stall_seconds_total": 0.3})
+        assert tune.advise_record(rec) == []
+
+    def test_relay_drops(self):
+        rec = _healthy_record(metrics={
+            "bst_relay_dropped_total": 5.0,
+            "bst_relay_sent_total": 100.0})
+        diags = tune.advise_record(rec)
+        assert [d.rule for d in diags] == ["relay_drops"]
+        assert diags[0].knob == "BST_RELAY_QUEUE"
+
+    def test_low_overlap_needs_the_trace(self):
+        trace_rep = {"stages": {"fusion": {
+            "d2h_s": 2.0, "write_s": 3.0,
+            "overlap": {"d2h_write": {"pct_of_d2h": 10.0}}}}}
+        diags = tune.advise_record(_healthy_record(), trace_rep)
+        assert [d.rule for d in diags] == ["low_d2h_write_overlap"]
+        d = diags[0]
+        assert d.knob == "BST_WRITE_THREADS"
+        assert d.evidence["stage"] == "fusion"
+        # without the trace decomposition the rule cannot fire
+        assert tune.advise_record(_healthy_record()) == []
+
+    def test_good_overlap_is_quiet(self):
+        trace_rep = {"stages": {"fusion": {
+            "d2h_s": 2.0, "write_s": 3.0,
+            "overlap": {"d2h_write": {"pct_of_d2h": 85.0}}}}}
+        assert tune.advise_record(_healthy_record(), trace_rep) == []
+
+    def test_multiple_rules_sorted_by_confidence(self):
+        rec = _healthy_record(metrics={
+            "bst_chunk_cache_hits_total": 1.0,
+            "bst_chunk_cache_misses_total": 99.0,
+            "bst_chunk_cache_evictions_total": 50.0,
+            "bst_relay_dropped_total": 1.0,
+            "bst_relay_sent_total": 1000.0})
+        diags = tune.advise_record(rec)
+        assert {d.rule for d in diags} == {"chunk_cache_thrash",
+                                           "relay_drops"}
+        assert [d.confidence for d in diags] == sorted(
+            (d.confidence for d in diags), reverse=True)
+
+    def test_suggested_value_clamps_to_tunable_hi(self):
+        hi = config.KNOBS["BST_CHUNK_CACHE_BYTES"].tunable.hi
+        rec = _healthy_record(metrics={
+            "bst_chunk_cache_hits_total": 10.0,
+            "bst_chunk_cache_misses_total": 90.0,
+            "bst_chunk_cache_evictions_total": 40.0})
+        with config.overrides({"BST_CHUNK_CACHE_BYTES": str(int(hi))}):
+            diags = tune.advise_record(rec)
+        assert int(diags[0].suggested_value) == int(hi)
+
+
+class TestAdviseCli:
+    def _import_record(self, tmp_path, hist, manifest):
+        mp = str(tmp_path / "manifest-planted.json")
+        with open(mp, "w") as f:
+            json.dump(manifest, f)
+        runner = CliRunner()
+        rid = _cli_ok(runner, ["history", "add", mp, "--history-dir",
+                               hist]).output.strip()
+        return runner, rid
+
+    def test_advise_json_and_table(self, tmp_path):
+        hist = str(tmp_path / "hist")
+        man = _healthy_record(metrics={
+            "bst_chunk_cache_hits_total": 10.0,
+            "bst_chunk_cache_misses_total": 90.0,
+            "bst_chunk_cache_evictions_total": 40.0})
+        runner, rid = self._import_record(tmp_path, hist, man)
+        # default REF = the latest record
+        out = _cli_ok(runner, ["tune", "advise", "--history-dir",
+                               hist]).output
+        assert "chunk_cache_thrash" in out and "BST_CHUNK_CACHE_BYTES" in out
+        doc = json.loads(_cli_ok(
+            runner, ["tune", "advise", rid, "--history-dir", hist,
+                     "--json"]).output)
+        assert [d["rule"] for d in doc["diagnoses"]] == \
+            ["chunk_cache_thrash"]
+        d = doc["diagnoses"][0]
+        assert d["knob"] and d["suggested_value"] and d["evidence"]
+
+    def test_advise_healthy_says_so(self, tmp_path):
+        hist = str(tmp_path / "hist")
+        runner, rid = self._import_record(tmp_path, hist,
+                                          _healthy_record())
+        out = _cli_ok(runner, ["tune", "advise", rid, "--history-dir",
+                               hist]).output
+        assert "no rules fired" in out
+
+    def test_advise_unknown_ref_is_clean_error(self, tmp_path):
+        hist = str(tmp_path / "hist")
+        self._import_record(tmp_path, hist, _healthy_record())
+        r = CliRunner().invoke(cli, ["tune", "advise", "nope",
+                                     "--history-dir", hist])
+        assert r.exit_code != 0 and "nope" in r.output
+
+
+def _sleep_for_knob(name="BST_WRITE_THREADS", optimum_log2=5.0):
+    """A workload whose runtime has a KNOWN minimum: 10ms per pow2 step
+    away from 2**optimum_log2, +10ms floor — far above timer noise."""
+    def fn():
+        v = config.get_int(name) or 1
+        time.sleep(0.01 * abs(math.log2(v) - optimum_log2) + 0.01)
+    return fn
+
+
+class TestAutotuner:
+    def test_converges_to_known_optimum(self, tmp_path):
+        hist = str(tmp_path / "hist")
+        wl = tune.CallableWorkload("synthetic-sleep", _sleep_for_knob())
+        seed = tune.Diagnosis(rule="planted", detail="", confidence=1.0,
+                              knob="BST_WRITE_THREADS",
+                              suggested_value="16")
+        res = tune.autotune(wl, diagnoses=[seed], trials_per_config=1,
+                            max_trials=10, min_gain=0.05,
+                            history_dir=hist, warmup=False)
+        # default 8 -> seeded 16 -> hill-climbs to the optimum 32 within
+        # a handful of trials (bounded, not exhaustive)
+        assert res.best_overrides == {"BST_WRITE_THREADS": "32"}
+        assert 3 <= len(res.trials) <= 6
+        assert res.best_seconds < res.baseline_seconds
+        # every trial is a first-class history record of tool tune-trial
+        entries = history.list_records(hist, tool="tune-trial")
+        assert len(entries) == len(res.trials)
+        assert {e["id"] for e in entries} == \
+            {t.record_id for t in res.trials}
+        # ...and perf-diff works on trials like on production runs
+        rep = history.diff(history.load_record(entries[0]["id"], hist),
+                           history.load_record(entries[-1]["id"], hist))
+        assert "wall_clock" in rep
+        # the winner persisted under this host's backend axes
+        backend, ndev = profiles.backend_signature()
+        store = tune.load_store(hist)
+        prof = tune.match_profile(store, backend=backend,
+                                  device_count=ndev, shape=wl.shape)
+        assert prof["overrides"] == {"BST_WRITE_THREADS": "32"}
+        assert prof["speedup"] >= 1.0
+
+    def test_insensitive_workload_keeps_defaults(self, tmp_path):
+        """Never-a-regression: when no candidate clears the min-gain
+        bar, the default configuration wins with an EMPTY override set
+        (best == baseline, speedup exactly 1.0)."""
+        hist = str(tmp_path / "hist")
+        wl = tune.CallableWorkload("flat", lambda: time.sleep(0.005))
+        res = tune.autotune(wl, force_knobs=("BST_WRITE_THREADS",),
+                            diagnoses=[], trials_per_config=1,
+                            max_trials=6, min_gain=0.5,
+                            history_dir=hist, warmup=False)
+        assert res.best_overrides == {}
+        assert res.best_seconds == res.baseline_seconds
+        prof = tune.load_store(hist)["profiles"][res.profile_key]
+        assert prof["overrides"] == {} and prof["speedup"] == 1.0
+
+    def test_crashing_candidate_never_adopted(self, tmp_path):
+        def fn():
+            if config.get_int("BST_WRITE_THREADS") == 4:
+                raise RuntimeError("boom at 4 threads")
+            time.sleep(0.005)
+
+        seed = tune.Diagnosis(rule="planted", detail="", confidence=1.0,
+                              knob="BST_WRITE_THREADS",
+                              suggested_value="4")
+        res = tune.autotune(tune.CallableWorkload("crashy", fn),
+                            diagnoses=[seed], trials_per_config=1,
+                            max_trials=6, min_gain=0.5,
+                            history_dir=str(tmp_path / "h"),
+                            warmup=False)
+        bad = [t for t in res.trials
+               if t.overrides.get("BST_WRITE_THREADS") == "4"]
+        assert bad and all(t.status == "error" for t in bad)
+        assert res.best_overrides.get("BST_WRITE_THREADS") != "4"
+        # the failed trial still landed in history, status error
+        rec = history.load_record(bad[0].record_id, str(tmp_path / "h"))
+        assert rec["status"] == "error"
+
+    def test_crashing_baseline_aborts(self, tmp_path):
+        def fn():
+            raise RuntimeError("always")
+
+        with pytest.raises(RuntimeError, match="default"):
+            tune.autotune(tune.CallableWorkload("dead", fn),
+                          diagnoses=[], trials_per_config=1,
+                          history_dir=str(tmp_path / "h"), warmup=False)
+
+    def test_max_trials_is_a_hard_cap(self, tmp_path):
+        seeds = [tune.Diagnosis(rule="p", detail="", confidence=1.0,
+                                knob=k, suggested_value=None)
+                 for k in ("BST_WRITE_THREADS", "BST_CHUNK_CACHE_BYTES",
+                           "BST_TILE_CACHE_BYTES", "BST_INFLIGHT_BYTES")]
+        res = tune.autotune(
+            tune.CallableWorkload("flat", lambda: time.sleep(0.002)),
+            diagnoses=seeds, trials_per_config=1, max_trials=4,
+            min_gain=0.5, history_dir=str(tmp_path / "h"), warmup=False)
+        assert len(res.trials) <= 4
+
+    def test_bool_knob_enumerates_flip(self, tmp_path):
+        seen = []
+
+        def fn():
+            seen.append(config.get_bool("BST_EARLY_DISPATCH"))
+            time.sleep(0.002)
+
+        seed = tune.Diagnosis(rule="p", detail="", confidence=1.0,
+                              knob="BST_EARLY_DISPATCH",
+                              suggested_value=None)
+        tune.autotune(tune.CallableWorkload("boolish", fn),
+                      diagnoses=[seed], trials_per_config=1,
+                      max_trials=4, min_gain=0.5,
+                      history_dir=str(tmp_path / "h"), warmup=False)
+        # baseline saw the default, the candidate saw the flip
+        assert len(set(seen)) == 2
+
+
+class TestProfileStore:
+    def _mk(self, shape, created_at=None, **kw):
+        p = profiles.make_profile(
+            backend=kw.pop("backend", "cpu"),
+            device_count=kw.pop("device_count", 1), shape=shape,
+            workload="t", overrides=kw.pop("overrides", {"BST_X": "1"}),
+            baseline_seconds=2.0, best_seconds=1.0, trials=3)
+        if created_at:
+            p["created_at"] = created_at
+        return p
+
+    def test_save_load_roundtrip_and_overwrite(self, tmp_path):
+        hist = str(tmp_path / "h")
+        key = profiles.save_profile(
+            self._mk("s1", overrides={"BST_WRITE_THREADS": "4"}), hist)
+        assert key == "cpu/1/s1"
+        store = profiles.load_store(hist)
+        assert store["schema"] == profiles.SCHEMA
+        assert store["profiles"][key]["overrides"] == \
+            {"BST_WRITE_THREADS": "4"}
+        # same key overwrites, store stays size 1
+        profiles.save_profile(
+            self._mk("s1", overrides={"BST_WRITE_THREADS": "8"}), hist)
+        store = profiles.load_store(hist)
+        assert len(store["profiles"]) == 1
+        assert store["profiles"][key]["overrides"] == \
+            {"BST_WRITE_THREADS": "8"}
+
+    def test_match_explicit_key_prefix_ambiguous(self, tmp_path):
+        hist = str(tmp_path / "h")
+        profiles.save_profile(self._mk("t2x2-a"), hist)
+        profiles.save_profile(self._mk("t2x2-b"), hist)
+        store = profiles.load_store(hist)
+        assert profiles.match_profile(
+            store, backend="", device_count=0,
+            ref="cpu/1/t2x2-a")["shape"] == "t2x2-a"
+        # unique prefix resolves; ambiguous prefix refuses
+        assert profiles.match_profile(
+            store, backend="", device_count=0,
+            ref="cpu/1/t2x2-b")["shape"] == "t2x2-b"
+        with pytest.raises(KeyError, match="ambiguous"):
+            profiles.match_profile(store, backend="", device_count=0,
+                                   ref="cpu/1/t2x2")
+        with pytest.raises(KeyError, match="no profile"):
+            profiles.match_profile(store, backend="", device_count=0,
+                                   ref="tpu/8/z")
+
+    def test_match_auto_exact_then_newest_same_axes(self, tmp_path):
+        hist = str(tmp_path / "h")
+        profiles.save_profile(
+            self._mk("old", created_at="2026-01-01T00:00:00"), hist)
+        profiles.save_profile(
+            self._mk("new", created_at="2026-06-01T00:00:00"), hist)
+        profiles.save_profile(
+            self._mk("other", backend="tpu", device_count=8,
+                     created_at="2026-07-01T00:00:00"), hist)
+        store = profiles.load_store(hist)
+        # exact shape wins
+        assert profiles.match_profile(
+            store, backend="cpu", device_count=1, shape="old",
+            ref="auto")["shape"] == "old"
+        # no shape match -> newest on the same backend axes, never the
+        # tpu profile
+        assert profiles.match_profile(
+            store, backend="cpu", device_count=1, shape="elsewhere",
+            ref="auto")["shape"] == "new"
+        # foreign axes -> None (auto is best-effort)
+        assert profiles.match_profile(
+            store, backend="gpu", device_count=4, ref="auto") is None
+
+    def test_no_history_dir_raises(self, monkeypatch):
+        monkeypatch.delenv("BST_HISTORY_DIR", raising=False)
+        with pytest.raises(FileNotFoundError):
+            profiles.load_store(None)
+
+
+class TestTuneRunCli:
+    def test_tiny_fusion_end_to_end(self, tmp_path):
+        """Acceptance: `bst tune run` on the built-in workload produces
+        a profile whose best is never worse than the default config, and
+        every trial is a history record."""
+        hist = str(tmp_path / "hist")
+        runner = CliRunner()
+        res = _json_tail(_cli_ok(
+            runner, ["tune", "run", "--history-dir", hist,
+                     "--trials", "1", "--max-trials", "3",
+                     "--knob", "BST_WRITE_THREADS", "--json"]).output)
+        assert res["workload"] == "tiny-fusion"
+        assert 1 <= len(res["trials"]) <= 3
+        assert res["best_seconds"] <= res["baseline_seconds"]
+        assert res["speedup"] >= 1.0
+        assert res["profile_key"]
+        # trials are first-class history records, browsable by tool
+        entries = json.loads(_cli_ok(
+            runner, ["history", "list", "--history-dir", hist,
+                     "--tool", "tune-trial", "--json"]).output)
+        assert len(entries) == len(res["trials"])
+        # the store lists/shows/applies the winner
+        out = _cli_ok(runner, ["tune", "list", "--history-dir",
+                               hist]).output
+        assert res["profile_key"] in out
+        prof = json.loads(_cli_ok(
+            runner, ["tune", "show", res["profile_key"], "--history-dir",
+                     hist]).output)
+        assert prof["key"] == res["profile_key"]
+        apply_out = _cli_ok(
+            runner, ["tune", "apply", "--history-dir", hist,
+                     res["profile_key"]]).output
+        assert res["profile_key"] in apply_out
+
+    def test_unknown_knob_and_missing_history_are_clean_errors(
+            self, tmp_path, monkeypatch):
+        runner = CliRunner()
+        r = runner.invoke(cli, ["tune", "run", "--history-dir",
+                                str(tmp_path / "h"), "--knob", "BST_NOPE"])
+        assert r.exit_code != 0 and "BST_NOPE" in r.output
+        monkeypatch.delenv("BST_HISTORY_DIR", raising=False)
+        r = runner.invoke(cli, ["tune", "run"])
+        assert r.exit_code != 0 and "history" in r.output
+
+    def test_apply_runs_tool_under_profile_scope(self, tmp_path):
+        hist = str(tmp_path / "hist")
+        profiles.save_profile(profiles.make_profile(
+            backend="cpu", device_count=1, shape="s", workload="t",
+            overrides={"BST_WRITE_THREADS": "4"}, baseline_seconds=1.0,
+            best_seconds=1.0, trials=1), hist)
+        out = _cli_ok(CliRunner(), [
+            "tune", "apply", "--history-dir", hist, "cpu/1/s",
+            "config", "--json"]).output
+        row = [r for r in _json_tail(out)
+               if r["name"] == "BST_WRITE_THREADS"][0]
+        assert row["value"] == 4 and row["source"] == "override"
+
+
+class TestConfigTunableSurface:
+    def test_tunable_metadata_in_config_json(self):
+        out = _cli_ok(CliRunner(), ["config", "--json"]).output
+        rows = {r["name"]: r for r in json.loads(out)}
+        t = rows["BST_WRITE_THREADS"]["tunable"]
+        assert t and t["lo"] == 1 and t["hi"] == 64
+        assert rows["BST_PROFILE_AUTO"]["tunable"] is None
+
+    def test_tunable_knobs_registry(self):
+        tk = config.tunable_knobs()
+        assert "BST_WRITE_THREADS" in tk
+        assert "BST_CHUNK_CACHE_BYTES" in tk
+        # correctness-affecting knobs are NOT tunable
+        assert "BST_HISTORY_DIR" not in tk
+        assert "BST_PROFILE_AUTO" not in tk
+        for name, k in tk.items():
+            if k.kind in ("int", "bytes"):
+                assert k.tunable.lo is not None, name
+                assert k.tunable.hi is not None, name
+
+
+class TestDaemonProfileApplication:
+    @pytest.fixture()
+    def daemon(self, tmp_path, monkeypatch):
+        from bigstitcher_spark_tpu.serve.daemon import Daemon
+
+        monkeypatch.setenv("BST_HISTORY_DIR", str(tmp_path / "hist"))
+        d = Daemon(str(tmp_path / "bst.sock"), slots=1,
+                   jobs_root=str(tmp_path / "jobs")).start()
+        try:
+            yield d
+        finally:
+            if not d.wait(timeout=0):
+                d.shutdown(drain=False, wait=True)
+
+    def _store_profile(self, tmp_path, overrides):
+        backend, ndev = profiles.backend_signature()
+        return profiles.save_profile(profiles.make_profile(
+            backend=backend, device_count=ndev, shape="daemon-test",
+            workload="t", overrides=overrides, baseline_seconds=1.0,
+            best_seconds=0.9, trials=2), str(tmp_path / "hist"))
+
+    def test_profile_applies_as_override_not_env(self, tmp_path, daemon):
+        from bigstitcher_spark_tpu.serve import client
+
+        self._store_profile(tmp_path, {"BST_WRITE_THREADS": "4"})
+        res = client.submit(daemon.socket_path, "config", ["--json"],
+                            profile="auto")
+        assert res["exit_code"] == 0
+        rows = json.loads(open(os.path.join(
+            res["telemetry_dir"], "output.log")).read())
+        row = [r for r in rows if r["name"] == "BST_WRITE_THREADS"][0]
+        assert row["value"] == 4 and row["source"] == "override"
+        # the applied key is auditable on the job and in its manifest
+        job = [j for j in client.list_jobs(daemon.socket_path)["jobs"]
+               if j["id"] == res["job"]][0]
+        assert job["profile"].endswith("daemon-test")
+        # the daemon process itself never saw the knob
+        assert "BST_WRITE_THREADS" not in os.environ
+
+    def test_explicit_set_wins_over_profile(self, tmp_path, daemon):
+        from bigstitcher_spark_tpu.serve import client
+
+        self._store_profile(tmp_path, {"BST_WRITE_THREADS": "4"})
+        res = client.submit(daemon.socket_path, "config", ["--json"],
+                            profile="auto",
+                            overrides={"BST_WRITE_THREADS": "2"})
+        rows = json.loads(open(os.path.join(
+            res["telemetry_dir"], "output.log")).read())
+        row = [r for r in rows if r["name"] == "BST_WRITE_THREADS"][0]
+        assert row["value"] == 2
+
+    def test_explicit_missing_profile_is_an_error(self, tmp_path, daemon):
+        from bigstitcher_spark_tpu.serve import client
+
+        self._store_profile(tmp_path, {})
+        with pytest.raises(RuntimeError, match="no profile"):
+            client.submit(daemon.socket_path, "config", [],
+                          profile="tpu/9/nothere")
+        # auto with an empty/unmatched store is best-effort: job runs
+        res = client.submit(daemon.socket_path, "config", ["--json"],
+                            profile="auto")
+        assert res["exit_code"] == 0
+
+    def test_profile_auto_knob_applies_without_flag(self, tmp_path,
+                                                    daemon, monkeypatch):
+        from bigstitcher_spark_tpu.serve import client
+
+        self._store_profile(tmp_path, {"BST_WRITE_THREADS": "4"})
+        monkeypatch.setenv("BST_PROFILE_AUTO", "1")
+        res = client.submit(daemon.socket_path, "config", ["--json"])
+        rows = json.loads(open(os.path.join(
+            res["telemetry_dir"], "output.log")).read())
+        row = [r for r in rows if r["name"] == "BST_WRITE_THREADS"][0]
+        assert row["value"] == 4 and row["source"] == "override"
+
+    def test_fusion_output_bit_identical_under_profile(self, tmp_path,
+                                                       daemon):
+        """The acceptance contract: a tuned profile changes performance
+        knobs only, so the fused bytes are identical with and without
+        it."""
+        from bigstitcher_spark_tpu.serve import client
+        from bigstitcher_spark_tpu.utils.testdata import \
+            make_synthetic_project
+
+        self._store_profile(tmp_path, {"BST_WRITE_THREADS": "2"})
+        proj = make_synthetic_project(
+            str(tmp_path / "proj"), n_tiles=(2, 2, 1),
+            tile_size=(64, 64, 32), overlap=16, jitter=0.0,
+            n_beads_per_tile=20)
+        runner = CliRunner()
+        for out in ("plain.zarr", "tuned.zarr"):
+            _cli_ok(runner, ["create-fusion-container",
+                             "-x", proj.xml_path,
+                             "-o", str(tmp_path / out),
+                             "-s", "ZARR", "-d", "UINT16",
+                             "--minIntensity", "0",
+                             "--maxIntensity", "65535"])
+        ra = client.submit(daemon.socket_path, "affine-fusion",
+                           ["-o", str(tmp_path / "plain.zarr")])
+        rb = client.submit(daemon.socket_path, "affine-fusion",
+                           ["-o", str(tmp_path / "tuned.zarr")],
+                           profile="auto")
+        assert ra["exit_code"] == 0 and rb["exit_code"] == 0
+        from bigstitcher_spark_tpu.io.chunkstore import ChunkStore
+
+        def vol(path):
+            ds = ChunkStore.open(path).open_dataset("0")
+            size = tuple(ds.shape[:3]) + (1,) * (len(ds.shape) - 3)
+            return np.asarray(ds.read((0,) * len(ds.shape), size))
+
+        assert np.array_equal(vol(str(tmp_path / "plain.zarr")),
+                              vol(str(tmp_path / "tuned.zarr")))
+
+
+class TestHistorySatellites:
+    def _seed_store(self, tmp_path, tools):
+        """Import one minimal manifest per tool name, in order; returns
+        (hist_dir, [record ids])."""
+        hist = str(tmp_path / "hist")
+        ids = []
+        for i, tool in enumerate(tools):
+            mp = str(tmp_path / f"manifest-{i}.json")
+            with open(mp, "w") as f:
+                json.dump({"tool": tool, "seconds": 1.0 + i,
+                           "status": "ok", "spans": {}, "metrics": {}}, f)
+            ids.append(history.record_manifest(mp, directory=hist))
+        return hist, ids
+
+    def test_list_records_tool_since_limit(self, tmp_path):
+        hist, ids = self._seed_store(
+            tmp_path, ["affine-fusion", "solver", "affine-fusion"])
+        assert [e["id"] for e in history.list_records(hist)] == ids
+        assert [e["id"] for e in
+                history.list_records(hist, tool="solver")] == [ids[1]]
+        # limit keeps the NEWEST N, still oldest-first
+        assert [e["id"] for e in history.list_records(hist, limit=2)] == \
+            ids[1:]
+        assert history.list_records(hist, limit=0) == []
+        # since: ISO-lexicographic, prefixes work
+        assert history.list_records(hist, since="2000") and \
+            history.list_records(hist, since="2999-01") == []
+        # filters compose
+        assert [e["id"] for e in history.list_records(
+            hist, tool="affine-fusion", limit=1)] == [ids[2]]
+
+    def test_history_list_cli_filters_and_json(self, tmp_path):
+        hist, ids = self._seed_store(
+            tmp_path, ["affine-fusion", "solver", "affine-fusion"])
+        runner = CliRunner()
+        entries = json.loads(_cli_ok(
+            runner, ["history", "list", "--history-dir", hist,
+                     "--tool", "affine-fusion", "--json"]).output)
+        assert [e["id"] for e in entries] == [ids[0], ids[2]]
+        # stable keys for scripting
+        assert set(entries[0]) >= {"id", "ts", "tool", "job", "status",
+                                   "seconds", "file"}
+        entries = json.loads(_cli_ok(
+            runner, ["history", "list", "--history-dir", hist,
+                     "--limit", "1", "--json"]).output)
+        assert [e["id"] for e in entries] == [ids[2]]
+
+    def test_perf_diff_last_defaults_to_same_tool(self, tmp_path):
+        """The satellite fix: --last 2 used to diff the two newest
+        records regardless of tool. It now anchors on the latest
+        record's tool — here fusion vs fusion, skipping the newer
+        solver-adjacent record."""
+        hist, ids = self._seed_store(
+            tmp_path, ["affine-fusion", "solver", "affine-fusion"])
+        rep = _json_tail(_cli_ok(
+            CliRunner(), ["perf-diff", "--last", "2", "--history-dir",
+                          hist, "--json"]).output)
+        assert rep["a"] == ids[0] and rep["b"] == ids[2]
+
+    def test_perf_diff_tool_pins_selection(self, tmp_path):
+        hist, ids = self._seed_store(
+            tmp_path, ["solver", "solver", "affine-fusion"])
+        rep = _json_tail(_cli_ok(
+            CliRunner(), ["perf-diff", "--last", "2", "--tool", "solver",
+                          "--history-dir", hist, "--json"]).output)
+        assert rep["a"] == ids[0] and rep["b"] == ids[1]
+        # too few records of the pinned tool is a clean error
+        r = CliRunner().invoke(cli, ["perf-diff", "--last", "3",
+                                     "--tool", "solver",
+                                     "--history-dir", hist])
+        assert r.exit_code != 0 and "3" in r.output
+
+    def test_perf_diff_cross_tool_warns_loudly(self, tmp_path):
+        # only one record of the latest tool: --last 2 falls back to a
+        # cross-tool diff but says so instead of silently comparing
+        hist, ids = self._seed_store(tmp_path,
+                                     ["solver", "affine-fusion"])
+        runner = CliRunner()
+        out = _cli_ok(runner, ["perf-diff", "--last", "2",
+                               "--history-dir", hist, "--json"]).output
+        assert "CROSS-TOOL" in out
+        assert "cross-tool diff" in out
+        rep = _json_tail(out)
+        assert rep["a"] == ids[0] and rep["b"] == ids[1]
+        # explicit cross-tool refs warn too
+        out = _cli_ok(runner, ["perf-diff", ids[0], ids[1],
+                               "--history-dir", hist, "--json"]).output
+        assert "cross-tool diff" in out
+
+
+class TestObservability:
+    def test_tune_metrics_and_spans_declared(self):
+        from bigstitcher_spark_tpu.observe import metric_names
+
+        for m in ("bst_tune_trials_total", "bst_tune_rules_fired_total",
+                  "bst_tune_profiles_applied_total"):
+            assert m in metric_names.METRICS
+        for s in ("tune.advise", "tune.trial"):
+            assert s in metric_names.SPANS
+
+    def test_advise_counts_rules_fired(self):
+        from bigstitcher_spark_tpu.observe import metrics as _metrics
+
+        c = _metrics.counter("bst_tune_rules_fired_total",
+                             rule="chunk_cache_thrash")
+        before = c.value
+        tune.advise_record(_healthy_record(metrics={
+            "bst_chunk_cache_hits_total": 10.0,
+            "bst_chunk_cache_misses_total": 90.0,
+            "bst_chunk_cache_evictions_total": 40.0}))
+        assert c.value == before + 1
