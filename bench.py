@@ -1136,6 +1136,32 @@ def measure_pipeline(xml_path):
     elided = summary["bytes_elided"]
     reread = summary["bytes_reread"]
     elision_pct = round(100.0 * elided / max(elided + reread, 1), 2)
+
+    # -- handoff leg: the same streamed spec with the HBM handoff cache
+    # enabled (BST_DAG_HANDOFF_BYTES): producer blocks reach same-mesh
+    # consumers as DEVICE arrays — no drain D2H, no host-LRU hop — with
+    # the identical per-rep cache clear so the legs differ by exactly the
+    # one knob
+    handoff_root = os.path.join(FIXTURE, "pipeline-handoff")
+    shutil.rmtree(handoff_root, ignore_errors=True)
+    os.makedirs(handoff_root, exist_ok=True)
+    rexml_h, resaved_h, fused_h, _ = stage_cmds(handoff_root, xml_path)
+    spec_h = json.loads(json.dumps(spec).replace(streamed_root,
+                                                 handoff_root))
+    get_cache().clear()
+    iob_h = _io_baseline()
+    os.environ["BST_DAG_HANDOFF_BYTES"] = str(1 << 30)
+    try:
+        t0 = time.time()
+        res_h = run_pipeline(spec_h, workdir=handoff_root)
+        handoff_s = time.time() - t0
+    finally:
+        os.environ.pop("BST_DAG_HANDOFF_BYTES", None)
+    io_h = _io_snapshot(iob_h)
+    summary_h = res_h.to_dict()
+    assert summary_h["ok"], summary_h
+    assert summary_h["blocks_handoff"] > 0, summary_h
+
     return {
         "metric": "pipeline_staged_over_streamed",
         "value": round(staged_s / max(streamed_s, 1e-9), 3),
@@ -1144,17 +1170,28 @@ def measure_pipeline(xml_path):
                  "as five one-shot CLIs with containers between stages "
                  "(cache cleared per stage = process-per-stage flow) vs "
                  "one streamed `bst pipeline` run with the resaved "
-                 "intermediate elided to memory"),
+                 "intermediate elided to memory; the handoff leg re-runs "
+                 "the streamed spec with BST_DAG_HANDOFF_BYTES=1G so "
+                 "same-mesh edges hand blocks over device-resident"),
         "staged_seconds": round(staged_s, 3),
         "streamed_seconds": round(streamed_s, 3),
+        "handoff_seconds": round(handoff_s, 3),
+        "streamed_over_handoff": round(streamed_s / max(handoff_s, 1e-9),
+                                       3),
         "staged_consumer_read_bytes": int(consumer_reads),
         "streamed_bytes_elided": int(elided),
         "streamed_bytes_reread": int(reread),
         "elision_pct": elision_pct,
         "blocks_streamed": summary["blocks_streamed"],
         "containers_elided": summary["containers_elided"],
+        "handoff_blocks": summary_h["blocks_handoff"],
+        "handoff_bytes_served": summary_h["bytes_handoff"],
+        "handoff_bytes_spilled": summary_h["bytes_spilled"],
+        "handoff_bytes_reread": summary_h["bytes_reread"],
         "edges": summary["edges"],
+        "handoff_edges": summary_h["edges"],
         "io": io,
+        "io_handoff": io_h,
     }
 
 

@@ -209,7 +209,15 @@ def downsample_cmd(path_in, dataset_in, datasets_out, downsampling,
         def read_job(blk, src_ds=prev, f=tuple(step)):
             src_off = [o * x for o, x in zip(blk.offset, f)]
             src_size = [s * x for s, x in zip(blk.size, f)]
-            return read_padded(src_ds.read, src_ds.shape, src_off, src_size)
+
+            def rd(off, size):
+                # a streamed producer's device-resident blocks serve
+                # straight from HBM (zero D2H + zero container decode);
+                # None falls back to the gated host read
+                dev = src_ds.read_device(off, size)
+                return dev if dev is not None else src_ds.read(off, size)
+
+            return read_padded(rd, src_ds.shape, src_off, src_size)
 
         def write_job(blk, out, dst_ds=dst):
             dst_ds.write(_convert_to_dtype(out, dst_ds.dtype), blk.offset)

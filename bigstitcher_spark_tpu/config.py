@@ -165,6 +165,13 @@ _knob("BST_DOG_BLUR", "str", "auto",
       "DoG blur strategy: fft (rfftn transfer multiply, the CPU win) or "
       "gemm (Toeplitz matmuls on the MXU); auto picks per backend.",
       choices=("auto", "fft", "gemm"), tunable=Tunable())
+_knob("BST_FUSED_DETECT", "bool", True,
+      "Compile DoG detection + descriptor extraction into ONE per-block "
+      "jitted program when a detection run requests descriptors "
+      "(models/detection.py): peaks never leave HBM between detect and "
+      "extract. 0 runs the staged two-dispatch path (bitwise-equal "
+      "output, one extra kernel round-trip per block).",
+      tunable=Tunable())
 
 # -- global solvers (ops/solve.py) -----------------------------------------
 _knob("BST_SOLVE_DEVICE", "bool", True,
@@ -285,6 +292,17 @@ _knob("BST_DAG_EXCHANGE_BYTES", "bytes", 256 << 20,
       "needs BST_CHUNK_CACHE_BYTES >= this budget, or evicted handoff "
       "chunks fall back to a container decode.",
       tunable=Tunable(lo=32 << 20, hi=8 << 30))
+_knob("BST_DAG_HANDOFF_BYTES", "bytes", 0,
+      "Byte budget of the DEVICE-resident (HBM) handoff cache between a "
+      "streaming pipeline's producer and consumer stages (dag/stream.py): "
+      "a producer publishing device arrays keeps its covered chunks in "
+      "HBM and the consumer's gated read is served as device arrays with "
+      "zero D2H + zero container decode; over budget the oldest chunks "
+      "spill to the host decoded-chunk LRU (backpressure semantics are "
+      "unchanged — spilled chunks still count as published). 0 disables "
+      "the device tier bit-identically (publishers drain to host as "
+      "before).",
+      tunable=Tunable(lo=64 << 20, hi=8 << 30))
 
 # -- install wrappers ------------------------------------------------------
 _knob("BST_DEVICES", "int", None,

@@ -99,6 +99,9 @@ class PipelineResult:
             "bytes_reread": sum(e["bytes_reread"] for e in self.edges),
             "blocks_streamed": sum(e["blocks_streamed"]
                                    for e in self.edges),
+            "blocks_handoff": sum(e["blocks_handoff"] for e in self.edges),
+            "bytes_handoff": sum(e["bytes_handoff"] for e in self.edges),
+            "bytes_spilled": sum(e["bytes_spilled"] for e in self.edges),
         }
 
 
@@ -354,6 +357,8 @@ def run_pipeline(spec: PipelineSpec | dict | str, *,
         blocks_streamed=sum(e["blocks_streamed"] for e in edge_rows),
         bytes_elided=sum(e["bytes_elided"] for e in edge_rows),
         bytes_reread=sum(e["bytes_reread"] for e in edge_rows),
+        blocks_handoff=sum(e["blocks_handoff"] for e in edge_rows),
+        bytes_handoff=sum(e["bytes_handoff"] for e in edge_rows),
         containers_elided=len(elided_roots),
     )
     return PipelineResult(

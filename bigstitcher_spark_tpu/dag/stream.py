@@ -22,6 +22,16 @@ Per streamed edge (a named dataset with producer and consumer stages):
   (io/chunkcache.py), so the consumer's gated read is served from memory
   with zero container decode. With the container itself elided to a
   ``memory://`` root the edge never touches disk at all.
+- **device-resident handoff** — one tier above the host LRU: a producer
+  that still holds a finished block in HBM publishes it through
+  ``Dataset.write_device`` into a byte-budgeted device cache
+  (``BST_DAG_HANDOFF_BYTES``), and a same-mesh consumer's gated read
+  resolves a THIRD way — served from device, as jax arrays, with zero
+  D2H and zero decode (``Dataset.read_device``). Over budget (or when a
+  host-side read needs the bytes) chunks spill to the host LRU + the
+  container, so backpressure and fallback semantics are exactly the
+  host tier's; with the budget at 0 the device tier is off and every
+  path is bit-identical to the host handoff.
 - **backpressure** — published-but-unconsumed bytes are charged against
   ``BST_DAG_EXCHANGE_BYTES``; an over-budget producer stalls until
   consumers drain. One escape hatch prevents the classic reorder
@@ -45,6 +55,7 @@ import itertools
 import os
 import threading
 import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -63,6 +74,10 @@ _EXCHANGE = _metrics.gauge("bst_dag_exchange_bytes")
 _QUEUE = _metrics.gauge("bst_dag_exchange_blocks")
 _STALL = _metrics.counter("bst_dag_producer_stall_seconds_total")
 _WAIT = _metrics.counter("bst_dag_consumer_wait_seconds_total")
+_HANDOFF_BLOCKS = _metrics.counter("bst_dag_handoff_blocks_total")
+_HANDOFF_SERVED = _metrics.counter("bst_dag_handoff_bytes_served_total")
+_HANDOFF_SPILL = _metrics.counter("bst_dag_handoff_spill_bytes_total")
+_HANDOFF_BYTES = _metrics.gauge("bst_dag_handoff_bytes")
 
 # wake-up tick for gate/stall waits: long enough to be free, short enough
 # that cancellation (polled on every tick) stays responsive
@@ -132,6 +147,9 @@ class EdgeState:
         self.bytes_published = 0
         self.bytes_elided = 0
         self.bytes_reread = 0
+        self.blocks_handoff = 0
+        self.bytes_handoff = 0
+        self.bytes_spilled = 0
         self.stall_s = 0.0
         self.wait_s = 0.0
 
@@ -145,6 +163,9 @@ class EdgeState:
             "bytes_published": self.bytes_published,
             "bytes_elided": self.bytes_elided,
             "bytes_reread": self.bytes_reread,
+            "blocks_handoff": self.blocks_handoff,
+            "bytes_handoff": self.bytes_handoff,
+            "bytes_spilled": self.bytes_spilled,
             "producer_stall_s": round(self.stall_s, 3),
             "consumer_wait_s": round(self.wait_s, 3),
         }
@@ -208,6 +229,85 @@ def _chunk_slices(pos, offset, block, dims):
         for d in range(nd))
 
 
+class _HandoffCache:
+    """Byte-budgeted LRU of DEVICE-resident produced chunks awaiting
+    their streamed consumers — the HBM tier above the host decoded-chunk
+    LRU. Keys are ``(edge root, dataset path, chunk position)``; entries
+    carry the device array, its byte size and the producing ``Dataset``
+    (the spill target's write handle). The lock is never held across
+    device ops or container IO: ``put_many`` returns what it evicted and
+    the CALLER spills those entries to the host tier."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bytes = 0
+
+    @staticmethod
+    def budget() -> int:
+        return config.get_bytes("BST_DAG_HANDOFF_BYTES")
+
+    def enabled(self) -> bool:
+        return self.budget() > 0
+
+    def put_many(self, items) -> list:
+        """Insert ``[(key, dev, nbytes, ds), ...]``; returns the evicted
+        entries (same shape) the caller must materialize to the host."""
+        evicted = []
+        budget = self.budget()
+        with self._lock:
+            for key, dev, nbytes, ds in items:
+                old = self._entries.pop(key, None)
+                if old is not None:
+                    self._bytes -= old[1]
+                self._entries[key] = (dev, nbytes, ds)
+                self._bytes += nbytes
+            while self._bytes > budget and self._entries:
+                k, (dev, nbytes, ds) = self._entries.popitem(last=False)
+                self._bytes -= nbytes
+                evicted.append((k, dev, nbytes, ds))
+            _HANDOFF_BYTES.set(self._bytes)
+        return evicted
+
+    def get_many(self, keys) -> list | None:
+        """The entries for ``keys`` (refreshing recency), or None when
+        ANY is absent — consumers assemble all-device or not at all."""
+        with self._lock:
+            if any(k not in self._entries for k in keys):
+                return None
+            out = []
+            for k in keys:
+                self._entries.move_to_end(k)
+                out.append(self._entries[k])
+            return out
+
+    def pop_many(self, keys) -> list:
+        """Remove and return the present entries among ``keys``."""
+        out = []
+        with self._lock:
+            for k in keys:
+                ent = self._entries.pop(k, None)
+                if ent is not None:
+                    self._bytes -= ent[1]
+                    out.append((k, *ent))
+            if out:
+                _HANDOFF_BYTES.set(self._bytes)
+        return out
+
+    def pop_root(self, root) -> list:
+        """Remove and return every entry under an edge root (flush)."""
+        with self._lock:
+            doomed = [k for k in self._entries if k[0] == root]
+            out = []
+            for k in doomed:
+                ent = self._entries.pop(k)
+                self._bytes -= ent[1]
+                out.append((k, *ent))
+            if out:
+                _HANDOFF_BYTES.set(self._bytes)
+        return out
+
+
 class StreamRegistry:
     """Process-wide edge registry + block exchange. One instance serves
     every concurrent pipeline run (runs register/unregister their own
@@ -223,6 +323,7 @@ class StreamRegistry:
         self._finished: set[StageToken] = set()
         self._exchange_bytes = 0
         self._gate_waiters = 0
+        self._handoff = _HandoffCache()
 
     # -- lifecycle (executor side) -----------------------------------------
 
@@ -236,6 +337,15 @@ class StreamRegistry:
                 chunkstore.set_dag_hooks(self)
 
     def unregister(self, edges) -> None:
+        # flush first, OUTSIDE the lock: device-published chunks of a
+        # non-elided edge may exist only in HBM, and the container must
+        # hold them before the edge identity disappears. Elided edges'
+        # entries are simply dropped — their memory:// container is
+        # removed right after this returns.
+        for e in edges:
+            ents = self._handoff.pop_root(e.root)
+            if ents and not e.elided:
+                self._spill(ents)
         with self._cond:
             for e in edges:
                 if self._edges.get(e.root) is e:
@@ -273,30 +383,48 @@ class StreamRegistry:
 
     # -- chunkstore hooks ---------------------------------------------------
 
+    def _consumer_edge(self, ds, offset):
+        """``(edge, tok, root, path, block, dims)`` when the ambient
+        stage is a streamed-edge consumer of ``ds`` with gateable
+        geometry; None otherwise (the read passes straight through)."""
+        if not self._edges:
+            return None
+        tok = _current_stage.get()
+        if tok is None:
+            return None
+        key = _ds_key(ds)
+        if key is None:
+            return None
+        root, path = key
+        edge = self._edges.get(root)
+        if edge is None or not edge.stream or tok not in edge.consumers:
+            return None
+        geo = _geometry(ds)
+        if geo is None:
+            return None
+        block, dims = geo
+        if len(block) != len(tuple(offset)):
+            return None
+        return edge, tok, root, path, block, dims
+
     def gate(self, ds, offset, shape) -> None:
         """Block a consumer stage's read until the producer has written
         every storage chunk the box touches (or all producers finished).
         No-op for non-edge datasets, non-consumer stages, and reads the
-        hook cannot reason about."""
-        if not self._edges:
+        hook cannot reason about. A HOST read arriving here also
+        materializes any chunks that exist only device-resident — the
+        host tiers below would otherwise decode container zeros."""
+        res = self._consumer_edge(ds, offset)
+        if res is None:
             return
-        tok = _current_stage.get()
-        if tok is None:
-            return
-        key = _ds_key(ds)
-        if key is None:
-            return
-        root, path = key
-        edge = self._edges.get(root)
-        if edge is None or not edge.stream or tok not in edge.consumers:
-            return
-        geo = _geometry(ds)
-        if geo is None:
-            return
-        block, _dims = geo
-        if len(block) != len(tuple(offset)):
-            return
+        edge, tok, root, path, block, _dims = res
         need = _touched_positions(offset, shape, block)
+        self._wait_and_consume(edge, tok, root, path, need)
+        ents = self._handoff.pop_many([(root, path, p) for p in need])
+        if ents:
+            self._spill(ents)
+
+    def _wait_and_consume(self, edge, tok, root, path, need) -> None:
         with self._cond:
             if not self._missing_locked(root, path, need, edge, tok):
                 self._consume_locked(edge, tok, root, path, need)
@@ -315,6 +443,66 @@ class StreamRegistry:
                     _WAIT.inc(dt)
                     self._cond.notify_all()
             self._consume_locked(edge, tok, root, path, need)
+
+    def device_read(self, ds, offset, shape):
+        """Consumer side, device tier — the gate's THIRD resolution:
+        after the ordinary wait (coverage-complete or producers-done),
+        assemble the whole box from HBM-resident handoff chunks and hand
+        it to the consumer as a device array: zero D2H, zero decode.
+        Returns None when any covering chunk is not device-resident; the
+        caller then falls back to ``Dataset.read``, whose gate spills
+        whatever IS device-resident so the host tiers hold real bytes."""
+        if not self._handoff.enabled():
+            return None
+        res = self._consumer_edge(ds, offset)
+        if res is None:
+            return None
+        edge, tok, root, path, block, dims = res
+        need = _touched_positions(offset, shape, block)
+        self._wait_and_consume(edge, tok, root, path, need)
+        ents = self._handoff.get_many([(root, path, p) for p in need])
+        if ents is None:
+            return None
+        import jax.numpy as jnp
+
+        off = [int(o) for o in offset]
+        shp = [int(s) for s in shape]
+        nd = len(block)
+        with profiling.span("dag.handoff_read", stage=edge.name):
+            if len(need) == 1:
+                dev = ents[0][0]
+                lo = [need[0][d] * block[d] for d in range(nd)]
+                src = tuple(slice(off[d] - lo[d], off[d] + shp[d] - lo[d])
+                            for d in range(nd))
+                out = dev if all(
+                    s.start == 0 and s.stop == dev.shape[d]
+                    for d, s in enumerate(src)) else dev[src]
+            else:
+                import jax
+
+                # chunks are committed to their producer devices; the
+                # assembly must live on ONE device (mixed placements are
+                # an error) — slice on the owner, copy only the slice
+                target = next(iter(ents[0][0].devices()))
+                out = jax.device_put(
+                    jnp.zeros(tuple(shp), ents[0][0].dtype), target)
+                for pos, (dev, _nb, _ds) in zip(need, ents):
+                    lo = [pos[d] * block[d] for d in range(nd)]
+                    src = tuple(
+                        slice(max(off[d] - lo[d], 0),
+                              min(off[d] + shp[d] - lo[d], dev.shape[d]))
+                        for d in range(nd))
+                    dst = tuple(
+                        slice(max(lo[d] - off[d], 0),
+                              max(lo[d] - off[d], 0)
+                              + (src[d].stop - src[d].start))
+                        for d in range(nd))
+                    out = out.at[dst].set(jax.device_put(dev[src], target))
+        nbytes = int(np.dtype(out.dtype).itemsize) * int(np.prod(shp))
+        with self._cond:
+            edge.bytes_handoff += nbytes
+        _HANDOFF_SERVED.inc(nbytes)
+        return out
 
     def _missing_locked(self, root, path, need, edge, tok) -> bool:
         cov = self._coverage.get((root, path))
@@ -372,6 +560,9 @@ class StreamRegistry:
         covered = _covered_positions(offset, data.shape, block, dims)
         if not covered:
             return
+        # a host write supersedes any device-resident copies of the same
+        # chunks: drop them, the fresh bytes live on the host path now
+        self._handoff.pop_many([(root, path, p) for p in covered])
         # write-through handoff: the consumer's gated read finds these in
         # the decoded-chunk cache and never decodes the container (copies,
         # so a driver reusing its write buffer cannot corrupt the cache)
@@ -389,22 +580,113 @@ class StreamRegistry:
             _trace.instant("dag.publish", stage=edge.name, nbytes=nbytes,
                            item=tuple(int(o) for o in offset))
         with self._cond:
-            cov = self._coverage.setdefault((root, path), set())
-            fresh = [p for p in covered if p not in cov]
-            cov.update(covered)
-            if fresh:
-                edge.blocks_published += len(fresh)
-                edge.bytes_published += per * len(fresh)
-                _BLOCKS.inc(len(fresh))
-                owed = {c for c in edge.consumers
-                        if c not in self._finished and c is not tok}
-                if owed:
-                    for p in fresh:
-                        self._pending[(root, path, p)] = [per, set(owed)]
-                    self._exchange_bytes += per * len(fresh)
-                self._update_gauges_locked()
-            self._cond.notify_all()
+            self._publish_locked(edge, tok, root, path, covered, per)
             self._stall_locked(edge, tok)
+
+    def _publish_locked(self, edge, tok, root, path, covered, per) -> None:
+        """Shared completion accounting of the host and device publish
+        paths: coverage, per-run totals, the exchange ledger."""
+        cov = self._coverage.setdefault((root, path), set())
+        fresh = [p for p in covered if p not in cov]
+        cov.update(covered)
+        if fresh:
+            edge.blocks_published += len(fresh)
+            edge.bytes_published += per * len(fresh)
+            _BLOCKS.inc(len(fresh))
+            owed = {c for c in edge.consumers
+                    if c not in self._finished and c is not tok}
+            if owed:
+                for p in fresh:
+                    self._pending[(root, path, p)] = [per, set(owed)]
+                self._exchange_bytes += per * len(fresh)
+            self._update_gauges_locked()
+        self._cond.notify_all()
+
+    def on_write_device(self, ds, dev, offset) -> bool:
+        """Producer side, device tier: keep a finished block's covered
+        chunks HBM-resident for same-mesh streamed consumers instead of
+        draining them to host. Returns True when the block was published
+        device-resident — the caller skips its host write entirely;
+        False sends it down the ordinary host write path.
+
+        All-or-nothing: a block whose box does not fully cover every
+        storage chunk it touches is rejected (partial chunks must merge
+        through the container like any host write), as are datasets the
+        spill tier could not hold coherently (non-cacheable stores)."""
+        if not self._edges or not self._handoff.enabled():
+            return False
+        tok = _current_stage.get()
+        if tok is None:
+            return False
+        key = _ds_key(ds)
+        if key is None:
+            return False
+        root, path = key
+        edge = self._edges.get(root)
+        if edge is None or not edge.stream or tok not in edge.producers:
+            return False
+        if not ds._cacheable():
+            return False
+        geo = _geometry(ds)
+        if geo is None:
+            return False
+        block, dims = geo
+        if len(block) != dev.ndim:
+            return False
+        touched = _touched_positions(offset, dev.shape, block)
+        covered = _covered_positions(offset, dev.shape, block, dims)
+        if not covered or len(covered) != len(touched):
+            return False
+        itemsize = int(np.dtype(dev.dtype).itemsize)
+        items, nbytes = [], 0
+        for pos in covered:
+            piece = dev[_chunk_slices(pos, offset, block, dims)]
+            nb = itemsize * int(np.prod(piece.shape))
+            items.append(((root, path, pos), piece, nb, ds))
+            nbytes += nb
+        evicted = self._handoff.put_many(items)
+        per = max(1, nbytes // len(covered))
+        if _trace.enabled():
+            _trace.instant("dag.handoff_publish", stage=edge.name,
+                           nbytes=nbytes,
+                           item=tuple(int(o) for o in offset))
+        _HANDOFF_BLOCKS.inc(len(covered))
+        with self._cond:
+            edge.blocks_handoff += len(covered)
+            self._publish_locked(edge, tok, root, path, covered, per)
+        if evicted:
+            self._spill(evicted)
+        with self._cond:
+            self._stall_locked(edge, tok)
+        return True
+
+    def _spill(self, entries) -> None:
+        """Materialize device-resident handoff chunks to the host tier:
+        fetch, write through the container (a non-elided output must hold
+        the real bytes) and re-seed the decoded-chunk LRU so a streamed
+        consumer's host read still elides the decode. Never called with a
+        registry lock held — the write re-enters ``on_write``."""
+        import jax
+
+        for (root, path, pos), dev, nbytes, ds in entries:
+            edge = self._edges.get(root)
+            with profiling.span("dag.handoff_spill",
+                                stage=edge.name if edge else path):
+                arr = np.asarray(jax.device_get(dev))
+                geo = _geometry(ds)
+                if geo is None:
+                    continue
+                block, _dims = geo
+                lo = [pos[d] * block[d] for d in range(len(block))]
+                ds.write(arr, lo)
+                if chunkcache.enabled() and ds._cacheable():
+                    chunkcache.get_cache().put(
+                        (ds._cache_key(), ds._cache_sig(), pos), arr,
+                        record_miss=False)
+            _HANDOFF_SPILL.inc(nbytes)
+            if edge is not None:
+                with self._cond:
+                    edge.bytes_spilled += nbytes
 
     def _stall_locked(self, edge, tok) -> None:
         """Backpressure: hold the producer while the exchange is over
@@ -465,3 +747,15 @@ _REGISTRY = StreamRegistry()
 
 def registry() -> StreamRegistry:
     return _REGISTRY
+
+
+def handoff_active() -> bool:
+    """True when a StreamRegistry is hooked AND the device handoff tier
+    has a budget: producer stages should offer their device-resident
+    outputs via ``Dataset.write_device`` before any D2H fetch."""
+    from ..io import chunkstore
+
+    hooks = chunkstore._DAG_HOOKS[0]
+    return (hooks is not None
+            and getattr(hooks, "_handoff", None) is not None
+            and hooks._handoff.enabled())

@@ -453,6 +453,19 @@ class Dataset:
             hooks.account_read(self, via, data.nbytes)
         return data.transpose(tuple(range(data.ndim))[::-1]) if self.reversed_axes else data
 
+    def read_device(self, offset: Sequence[int], shape: Sequence[int]):
+        """Serve a read as a DEVICE array straight from a streaming
+        pipeline's HBM handoff cache (dag/stream.py): zero D2H, zero
+        container decode. Returns None whenever that tier cannot serve
+        the whole box — callers fall back to :meth:`read`."""
+        hooks = _DAG_HOOKS[0]
+        if hooks is None:
+            return None
+        fn = getattr(hooks, "device_read", None)
+        if fn is None:
+            return None
+        return fn(self, offset, shape)
+
     def _native_read(self, offset: Sequence[int],
                      shape: Sequence[int]) -> np.ndarray | None:
         """N5 + zstd/raw local read via the native codec: chunk files decode
@@ -537,6 +550,20 @@ class Dataset:
             # write-through handoff, backpressure) — AFTER the invalidation
             # above so the handoff's cache entries survive it
             hooks.on_write(self, data, offset)
+
+    def write_device(self, dev, offset: Sequence[int]) -> bool:
+        """Publish a DEVICE-resident block to a streaming pipeline's HBM
+        handoff cache (dag/stream.py) instead of draining it to host.
+        Returns True when the block was accepted device-resident — the
+        caller skips the fetch and the host :meth:`write` entirely;
+        False means the block must take the ordinary host write path."""
+        hooks = _DAG_HOOKS[0]
+        if hooks is None:
+            return False
+        fn = getattr(hooks, "on_write_device", None)
+        if fn is None:
+            return False
+        return bool(fn(self, dev, offset))
 
     def _write_impl(self, data: np.ndarray, offset: Sequence[int]) -> None:
         if (self._native_write(data, offset)

@@ -239,28 +239,129 @@ def fuse_grid_block(
     return fused[sl], wsum[sl]
 
 
-def build_coefficient_arrays(sd, loader, plans, coefficients, nb):
-    """(nb, Cx,Cy,Cz, 2) grid stack + (nb, 3, 4) lpos->grid affines for the
-    first ``len(plans)`` slots (identity scale for missing/padded views).
-    Shared by the composite and per-block gather paths so the coordinate
-    convention cannot diverge: level coords -> grid coords with full-res
-    px = f*l + (f-1)/2 and cell centers at (k+0.5)*cs - 0.5,
-    cs = view_size/dims (BlkAffineFusion coefficients semantics)."""
+def _coeff_grid_affine(sd, loader, p, cdims):
+    """(3, 4) lpos->grid affine for one view plan: level coords -> grid
+    coords with full-res px = f*l + (f-1)/2 and cell centers at
+    (k+0.5)*cs - 0.5, cs = view_size/dims (BlkAffineFusion coefficients
+    semantics). The one place the convention lives, shared by the
+    composite and per-block gather paths so it cannot diverge."""
+    f = np.asarray(loader.downsampling_factors(p.view.setup)[p.level],
+                   np.float64)
+    cs = np.array(sd.view_size(p.view), np.float64) / np.array(cdims)
+    aff = np.zeros((3, 4), np.float32)
+    aff[:, :3] = np.diag(f / cs)
+    aff[:, 3] = ((f - 1) / 2.0 + 0.5) / cs - 0.5
+    return aff
+
+
+def _coeff_digest(coefficients) -> bytes:
+    """Content signature of a coefficient set: view identity + grid bytes.
+    Any regenerated/reloaded grid (a new solve, a store round-trip after a
+    rewrite) hashes differently, so a stale device table can never serve a
+    changed solve — the in-memory equivalent of the tile cache's
+    (signature, write-generation) key."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for v in sorted(coefficients, key=lambda v: (v.timepoint, v.setup)):
+        g = np.ascontiguousarray(coefficients[v], np.float32)
+        h.update(np.asarray([v.timepoint, v.setup, *g.shape],
+                            np.int64).tobytes())
+        h.update(g.tobytes())
+    return h.digest()
+
+
+def _coeff_rows(coefficients) -> dict:
+    """Canonical {view: table row} assignment (row 0 is the identity)."""
+    views = sorted(coefficients, key=lambda v: (v.timepoint, v.setup))
+    return {v: i + 1 for i, v in enumerate(views)}
+
+
+# One-time device residency for intensity-correction grids: the old
+# per-block path re-staged the FULL (vb, Cx,Cy,Cz, 2) grid stack into
+# every block's kernel inputs, so identical coefficient bytes re-crossed
+# H2D with every fused block. The table uploads once per coefficient-set
+# content digest; per-block inputs become a device-side jnp.take.
+_COEFF_TABLE_KEEP = 4
+
+
+def coefficient_table(coefficients):
+    """(table, rows): ``table`` a DEVICE (n_views+1, Cx,Cy,Cz, 2) stack
+    whose row 0 is the identity map (gain 1, offset 0) for padded/missing
+    slots, ``rows`` the {view: row} map. Uploaded at most once per content
+    digest (LRU of ``_COEFF_TABLE_KEEP`` sets)."""
+    import jax
+
+    dig = _coeff_digest(coefficients)
+    with _TILE_CACHE_LOCK:
+        ent = _COEFF_TABLE_CACHE.get(dig)
+        if ent is not None:
+            _COEFF_TABLE_CACHE.move_to_end(dig)
+            return ent
+    rows = _coeff_rows(coefficients)
     cdims = next(iter(coefficients.values())).shape[:3]
-    coeffs = np.zeros((nb, *cdims, 2), np.float32)
-    coeffs[..., 0] = 1.0
+    host = np.zeros((len(rows) + 1, *cdims, 2), np.float32)
+    host[..., 0] = 1.0
+    for v, r in rows.items():
+        host[r] = coefficients[v]
+    table = jax.device_put(host)
+    _H2D_BYTES.inc(int(table.nbytes))
+    with _TILE_CACHE_LOCK:
+        _COEFF_TABLE_CACHE[dig] = (table, rows)
+        while len(_COEFF_TABLE_CACHE) > _COEFF_TABLE_KEEP:
+            _COEFF_TABLE_CACHE.popitem(last=False)
+    return table, rows
+
+
+def register_coefficient_table(coefficients, per_view_dev) -> None:
+    """Adopt ALREADY-DEVICE-RESIDENT per-view grids for ``coefficients``
+    (the solve→fusion handoff: models.intensity registers the CG solver's
+    device output here, reshaped on device, so fusion's first
+    :func:`coefficient_table` lookup hits without the grids ever making a
+    host->device round trip). ``per_view_dev``: {view: device
+    (Cx,Cy,Cz,2)} matching ``coefficients`` bit-for-bit."""
+    import jax.numpy as jnp
+
+    rows = _coeff_rows(coefficients)
+    if set(rows) != set(per_view_dev):
+        return
+    cdims = next(iter(coefficients.values())).shape[:3]
+    ident = jnp.concatenate(
+        [jnp.ones((1, *cdims, 1), jnp.float32),
+         jnp.zeros((1, *cdims, 1), jnp.float32)], axis=-1)
+    order = sorted(rows, key=rows.get)
+    table = jnp.concatenate(
+        [ident] + [jnp.asarray(per_view_dev[v],
+                               jnp.float32)[None] for v in order], axis=0)
+    _H2D_SAVED.inc(int(table.nbytes))  # the upload that never happens
+    dig = _coeff_digest(coefficients)
+    with _TILE_CACHE_LOCK:
+        _COEFF_TABLE_CACHE[dig] = (table, rows)
+        while len(_COEFF_TABLE_CACHE) > _COEFF_TABLE_KEEP:
+            _COEFF_TABLE_CACHE.popitem(last=False)
+
+
+def gather_coefficient_inputs(sd, loader, plans, coefficients, nb):
+    """Per-block coefficient kernel inputs off the device-resident table:
+    a DEVICE (nb, Cx,Cy,Cz, 2) row gather — it rides the work loop's
+    device-side batch stacking, so zero grid bytes cross H2D per block —
+    plus the tiny host (nb, 3, 4) lpos->grid affines (48 B/view)."""
+    import jax.numpy as jnp
+
+    table, rows = coefficient_table(coefficients)
+    cdims = tuple(int(s) for s in table.shape[1:4])
+    idx = np.zeros((nb,), np.int32)
     coeff_affs = np.zeros((nb, 3, 4), np.float32)
     coeff_affs[:, :, :3] = np.eye(3)
     for i, p in enumerate(plans):
-        grid = coefficients.get(p.view)
-        if grid is None:
+        r = rows.get(p.view)
+        if r is None or coefficients.get(p.view) is None:
             continue
-        coeffs[i] = grid
-        f = np.asarray(loader.downsampling_factors(p.view.setup)[p.level],
-                       np.float64)
-        cs = np.array(sd.view_size(p.view), np.float64) / np.array(cdims)
-        coeff_affs[i, :, :3] = np.diag(f / cs)
-        coeff_affs[i, :, 3] = ((f - 1) / 2.0 + 0.5) / cs - 0.5
+        idx[i] = r
+        coeff_affs[i] = _coeff_grid_affine(sd, loader, p, cdims)
+    coeffs = jnp.take(table, jnp.asarray(idx), axis=0)
+    # grid bytes the per-block re-staging path would have re-shipped
+    _H2D_SAVED.inc(int(coeffs.nbytes))
     return coeffs, coeff_affs
 
 
@@ -312,7 +413,7 @@ def _gather_inputs(sd, loader, plans, pshape, vb, blend, inside_offset,
 
     coeffs = coeff_affs = None
     if coefficients is not None:
-        coeffs, coeff_affs = build_coefficient_arrays(
+        coeffs, coeff_affs = gather_coefficient_inputs(
             sd, loader, plans, coefficients, vb)
     ioffs = np.tile(np.asarray(inside_offset, np.float32), (vb, 1))
     return (patches, affines, offsets, img_dims, borders, ranges, valid,
@@ -525,7 +626,7 @@ def plan_composite_volume(
         ranges[i] = np.asarray(blend.range) / np.asarray(factors)
     coeffs = coeff_affs = None
     if coefficients is not None:
-        coeffs, coeff_affs = build_coefficient_arrays(
+        coeffs, coeff_affs = gather_coefficient_inputs(
             sd, loader, plans, coefficients, len(plans))
     return CompositePlan(plans, out_shape, tuple(windows), tuple(n_offs),
                          pad, fracs, img_dims, borders, ranges, inside_offs,
@@ -546,6 +647,9 @@ from collections import OrderedDict as _OrderedDict
 _TILE_CACHE: "_OrderedDict[tuple, object]" = _OrderedDict()
 _TILE_CACHE_LOCK = _threading.Lock()
 _TILE_CACHE_BYTES = [0]
+# device-resident coefficient tables (coefficient_table above); shares the
+# tile-cache lock — both are tiny critical sections on the same call paths
+_COEFF_TABLE_CACHE: "_OrderedDict[bytes, tuple]" = _OrderedDict()
 
 
 def _tile_cache_budget() -> int:
@@ -729,7 +833,14 @@ def _drain_device_volume(out, out_ds, zarr_ct, pyramid=(),
         return [(x0, vol[x0:min(x0 + step, vol.shape[0])])
                 for x0 in range(0, vol.shape[0], step)]
 
+    from ..dag.stream import handoff_active
+
+    handoff = handoff_active() and zarr_ct is None
+
     def prime(jobs):
+        if handoff:
+            return  # slabs are offered to the HBM handoff tier first —
+            # pre-starting their D2H would burn wire for claimed slabs
         for _, _, slab, _ in jobs:
             try:
                 slab.copy_to_host_async()
@@ -752,6 +863,11 @@ def _drain_device_volume(out, out_ds, zarr_ct, pyramid=(),
         # fetching/writing between slabs (writes are chunk-atomic)
         _cancel.check("fusion drain")
         ds, x0, slab, epi = job
+        # device-resident handoff: a streamed same-mesh consumer takes the
+        # slab as device chunks straight out of HBM — no D2H, no write, no
+        # container decode on its side (dag.stream publishes + accounts)
+        if handoff and ds.write_device(slab, (x0, 0, 0)):
+            return
         nb = int(slab.nbytes)   # known pre-fetch: device arrays size freely
         d2h_span = (profiling.span("fusion.epilogue.d2h", item=int(x0),
                                    nbytes=nb) if epi else
@@ -946,11 +1062,7 @@ def _fuse_volume_sharded(
 
             written: dict[tuple, int] = {}
 
-            def consume(item, data, *lvls):
-                block, bg, plans = item
-                sl = tuple(slice(0, s) for s in block.size)
-                _write_block(out_ds, data[sl], block, zarr_ct)
-                written[tuple(block.offset)] = int(np.prod(block.size))
+            def epi_pieces(block, lvls):
                 for lv, ldata in zip(epi, lvls):
                     a = lv.abs_factor
                     off = tuple(int(o) // int(f)
@@ -961,10 +1073,36 @@ def _fuse_volume_sharded(
                     size = tuple(e - o for e, o in zip(end, off))
                     if any(s <= 0 for s in size):
                         continue
+                    yield lv, ldata, off, size
+
+            def consume(item, data, *lvls):
+                block, bg, plans = item
+                sl = tuple(slice(0, s) for s in block.size)
+                _write_block(out_ds, data[sl], block, zarr_ct)
+                written[tuple(block.offset)] = int(np.prod(block.size))
+                for lv, ldata, off, size in epi_pieces(block, lvls):
                     _write_epilogue_block(
                         lv.ds, ldata[tuple(slice(0, s) for s in size)],
                         off, zarr_ct)
-                    pwritten[(a, off)] = int(np.prod(size))
+                    pwritten[(lv.abs_factor, off)] = int(np.prod(size))
+
+            def device_consume(item, data, *lvls):
+                # offer the block to the HBM handoff tier BEFORE any D2H:
+                # a claimed block stays device-resident for the streamed
+                # consumer stage and its rows never cross the wire. All or
+                # nothing per item — a partial claim host-writes everything
+                # (on_write supersedes the device copies, so no stale read)
+                block, bg, plans = item
+                sl = tuple(slice(0, s) for s in block.size)
+                if not out_ds.write_device(data[sl], block.offset):
+                    return False
+                for lv, ldata, off, size in epi_pieces(block, lvls):
+                    piece = ldata[tuple(slice(0, s) for s in size)]
+                    if not lv.ds.write_device(piece, off):
+                        return False
+                    pwritten[(lv.abs_factor, off)] = int(np.prod(size))
+                written[tuple(block.offset)] = int(np.prod(block.size))
+                return True
 
             # pack several blocks per device per batch: fusion dispatches
             # are compute-light, so fewer+bigger launches amortize dispatch
@@ -988,6 +1126,8 @@ def _fuse_volume_sharded(
                     [int(c) // int(a) for c, a in zip(compute_block,
                                                       lv.abs_factor)])) \
                     * np.dtype(out_dtype or "float32").itemsize
+            from ..dag.stream import handoff_active
+
             run_sharded_batches(
                 items, build, kernel_call, consume, n_dev, pool,
                 label=f"fusion batch {key}", progress=progress,
@@ -995,6 +1135,9 @@ def _fuse_volume_sharded(
                 out_bytes_per_item=item_out,
                 workspace_mult=3.0,
                 device_drain=direct,
+                device_consume=(device_consume
+                                if handoff_active() and zarr_ct is None
+                                else None),
             )
             stats.voxels += sum(written.values())
     finally:

@@ -27,8 +27,14 @@ from ..io.spimdata import SpimData, ViewId
 from ..ops.dog import (
     dog_block_topk_batch,
     dog_block_topk_batch_impl,
+    dog_detect_extract_batch,
+    dog_detect_extract_batch_impl,
     dog_halo,
     sample_trilinear,
+)
+from ..ops.descriptors import (
+    block_descriptors_batch,
+    block_descriptors_batch_impl,
 )
 from ..parallel.mesh import make_mesh, run_sharded_batches, shard_jit
 from ..ops.downsample import downsample_block
@@ -69,6 +75,12 @@ class DetectionParams:
     # device-side compaction budget: K strongest candidates per block leave
     # the device (count is returned, so truncation is detected and warned)
     max_candidates_per_block: int = 4096
+    # geometric descriptor extraction riding the detection pass: when on,
+    # each block's peaks get kNN-frame descriptors computed WITHOUT leaving
+    # HBM (one fused program per block, gated by BST_FUSED_DETECT)
+    extract_descriptors: bool = False
+    descriptor_neighbors: int = 3
+    descriptor_redundancy: int = 1
 
     @property
     def downsampling(self) -> tuple[int, int, int]:
@@ -86,6 +98,10 @@ class ViewDetections:
     points: np.ndarray            # (N,3) float64, full-res view-local px
     values: np.ndarray            # (N,) DoG response at the detection
     intensities: np.ndarray | None = None
+    # extract_descriptors riders: per-point kNN-frame descriptors (N, S, d)
+    # and their validity (points near block borders may lack a full pool)
+    descriptors: np.ndarray | None = None
+    descriptor_valid: np.ndarray | None = None
 
 
 @dataclass
@@ -134,13 +150,20 @@ class _ViewPlan:
 
 def _read_mirror(loader: ViewLoader, view, level, offset, shape) -> np.ndarray:
     """read_block with mirror (reflect) padding outside the image — matches
-    the reference's extended images so borders don't produce edge extrema."""
+    the reference's extended images so borders don't produce edge extrema.
+    When a streamed producer's device-resident blocks cover the box the
+    read serves straight from HBM (``Dataset.read_device``) and the
+    padding runs on device — values identical to the host path."""
     ds = loader.open(view, level)
     full = ds.shape
     lo = [max(0, int(o)) for o in offset]
     hi = [min(int(f), int(o) + int(s)) for f, o, s in zip(full, offset, shape)]
     if all(h > l for l, h in zip(lo, hi)):
-        data = ds.read(lo, [h - l for l, h in zip(lo, hi)])
+        size = [h - l for l, h in zip(lo, hi)]
+        rd = getattr(ds, "read_device", None)
+        data = rd(lo, size) if rd is not None else None
+        if data is None:
+            data = ds.read(lo, size)
     else:
         return np.zeros(tuple(int(s) for s in shape),
                         dtype=np.dtype(ds.dtype))
@@ -149,10 +172,17 @@ def _read_mirror(loader: ViewLoader, view, level, offset, shape) -> np.ndarray:
     if any(p != (0, 0) for p in pad):
         capped = [(min(p0, data.shape[d] - 1), min(p1, data.shape[d] - 1))
                   for d, (p0, p1) in enumerate(pad)]
-        data = np.pad(data, capped, mode="reflect")
         extra = [(p[0] - c[0], p[1] - c[1]) for p, c in zip(pad, capped)]
-        if any(e != (0, 0) for e in extra):
-            data = np.pad(data, extra, mode="edge")
+        if isinstance(data, np.ndarray):
+            data = np.pad(data, capped, mode="reflect")
+            if any(e != (0, 0) for e in extra):
+                data = np.pad(data, extra, mode="edge")
+        else:
+            import jax.numpy as jnp
+
+            data = jnp.pad(data, capped, mode="reflect")
+            if any(e != (0, 0) for e in extra):
+                data = jnp.pad(data, extra, mode="edge")
     return data
 
 
@@ -260,21 +290,85 @@ def _make_dog_kernel(n_dev: int, params: DetectionParams,
     sharded over the device mesh (one/few blocks per device). ``rel``:
     residual downsampling the kernel applies on device (blocks arrive at
     level resolution, native dtype)."""
+    desc = None
+    if params.extract_descriptors:
+        from .. import config
+
+        desc = (int(params.descriptor_neighbors),
+                int(params.descriptor_redundancy),
+                bool(config.get_bool("BST_FUSED_DETECT")))
     return _make_dog_kernel_cached(
         n_dev, float(params.sigma), bool(params.find_max),
         bool(params.find_min), int(params.max_candidates_per_block),
-        dog_halo(params.sigma), tuple(int(r) for r in rel))
+        dog_halo(params.sigma), tuple(int(r) for r in rel), desc)
 
 
 @functools.lru_cache(maxsize=32)
-def _make_dog_kernel_cached(n_dev, sigma, find_max, find_min, k, halo, rel):
+def _make_dog_kernel_cached(n_dev, sigma, find_max, find_min, k, halo, rel,
+                            desc=None):
     """lru_cache'd so repeated detections in one process (multi-run benches,
     detection+nonrigid pipelines) reuse the sharded jit instead of
-    recompiling (same defect class as the nonrigid kernel, fixed r4)."""
+    recompiling (same defect class as the nonrigid kernel, fixed r4).
+
+    ``desc``: None for plain detection; (n_neighbors, redundancy, fused)
+    for detect+extract. fused=True compiles ONE program per block batch —
+    the peaks never leave HBM between top-K and the descriptor frame math,
+    and the whole dispatch sits under the "detection.kernel" span. The
+    staged fallback (fused=False, BST_FUSED_DETECT=0) runs the identical
+    impl functions as two dispatches with a "detection.extract" span on the
+    second, so fused-vs-staged outputs are bitwise comparable."""
     from types import SimpleNamespace
 
     params = SimpleNamespace(sigma=sigma, find_max=find_max,
                              find_min=find_min)
+    if desc is not None:
+        nn, red, fused = desc
+        if fused:
+            if n_dev <= 1:
+                def kernel(blocks, lo, hi, thr, origins):
+                    with profiling.span("detection.kernel"):
+                        return dog_detect_extract_batch(
+                            blocks, lo, hi, thr, origins, params.sigma,
+                            params.find_max, params.find_min, k, halo, rel,
+                            nn, red, True)
+                return kernel
+            mesh = make_mesh(n_dev)
+            fn = shard_jit(
+                lambda b, l, h, t, o: dog_detect_extract_batch_impl(
+                    b, l, h, t, o, params.sigma, params.find_max,
+                    params.find_min, k, halo, rel, nn, red, True),
+                mesh, n_in=5, n_out=7,
+            )
+
+            def kernel(blocks, lo, hi, thr, origins):
+                with profiling.span("detection.kernel"):
+                    return fn(blocks, lo, hi, thr, origins)
+            return kernel
+        # staged two-pass: same impls, two compiled dispatches; the
+        # (sub, valid) intermediates still stay on device between them
+        detect = _make_dog_kernel_cached(n_dev, sigma, find_max, find_min,
+                                         k, halo, rel, None)
+        if n_dev <= 1:
+            def extract(sub, valid):
+                with profiling.span("detection.extract"):
+                    return block_descriptors_batch(sub, valid, nn, red, True)
+        else:
+            efn = shard_jit(
+                lambda s, v: block_descriptors_batch_impl(s, v, nn, red,
+                                                          True),
+                make_mesh(n_dev), n_in=2, n_out=2,
+            )
+
+            def extract(sub, valid):
+                with profiling.span("detection.extract"):
+                    return efn(sub, valid)
+
+        def kernel(blocks, lo, hi, thr, origins):
+            idx, sub, val, valid, count = detect(blocks, lo, hi, thr,
+                                                 origins)
+            dsc, dvalid = extract(sub, valid)
+            return idx, sub, val, valid, count, dsc, dvalid
+        return kernel
     if n_dev <= 1:
         def kernel(blocks, lo, hi, thr, origins):
             with profiling.span("detection.kernel"):
@@ -387,7 +481,7 @@ def detect_interest_points(
                 np.float32(params.threshold),
                 np.array([m - halo for m in job.core.min], np.int32))
 
-    def consume(job: _BlockJob, idx, sub, vals, valid, count):
+    def consume(job: _BlockJob, idx, sub, vals, valid, count, *extra):
         shape = job.core.shape
         k = len(idx)
         if int(count) > k:
@@ -413,7 +507,12 @@ def detect_interest_points(
                + np.array(job.core.min, np.float64))
         vv = vals[keep].astype(np.float64)
         order = np.lexsort(pts.T[::-1])
-        job.result = (pts[order], vv[order])
+        if extra:  # (desc, dvalid) riders from detect+extract kernels
+            dsc, dvalid = extra
+            job.result = (pts[order], vv[order], dsc[keep][order],
+                          dvalid[keep][order].astype(bool))
+        else:
+            job.result = (pts[order], vv[order])
 
     pool = CtxThreadPool(max_workers=8)
     try:
@@ -437,12 +536,19 @@ def detect_interest_points(
             # pooled float32 blocks batch_size was tuned for — scale the
             # per-device packing down so batch device memory stays bounded
             rel_vol = int(np.prod(rel))
+            wmult = 8.0
+            if params.extract_descriptors:
+                # the (K, K) masked-distance matrix of the extract half
+                # dominates its workspace; express it relative to the input
+                kk = int(params.max_candidates_per_block) ** 2 * 4
+                wmult += kk / max(1, int(np.prod(shp))
+                                  * np.dtype(dt).itemsize)
             run_sharded_batches(bjobs, build, kernel_fn, consume, n_dev, pool,
                                 label="detection batch",
                                 per_dev=max(1, per_dev // rel_vol),
                                 # DoG expands the native-dtype input to
                                 # several pooled f32 volumes on device
-                                workspace_mult=8.0)
+                                workspace_mult=wmult)
     finally:
         pool.shutdown(wait=True)
 
@@ -452,20 +558,37 @@ def detect_interest_points(
         if job.result is not None:
             per_view[job.view_idx].append(job.result)
 
+    want_desc = bool(params.extract_descriptors)
     out = []
     for vi, v in enumerate(view_list):
         plan = plans[v]
-        if per_view[vi]:
-            pts = np.concatenate([p for p, _ in per_view[vi]])
-            vals = np.concatenate([w for _, w in per_view[vi]])
+        res = per_view[vi]
+        if res:
+            pts = np.concatenate([r[0] for r in res])
+            vals = np.concatenate([r[1] for r in res])
+            riders = ((np.concatenate([np.asarray(r[2]) for r in res]),
+                       np.concatenate([np.asarray(r[3]) for r in res]))
+                      if want_desc else ())
         else:
             pts, vals = np.zeros((0, 3)), np.zeros(0)
-        pts, vals = _filter_spots(pts, vals, overlap_boxes.get(v), params)
+            riders = ()
+            if want_desc:
+                from ..ops.descriptors import subset_combinations
+
+                nn = int(params.descriptor_neighbors)
+                s = len(subset_combinations(
+                    nn + int(params.descriptor_redundancy), nn))
+                riders = (np.zeros((0, s, nn * 3), np.float32),
+                          np.zeros(0, bool))
+        pts, vals, riders = _filter_spots(pts, vals, overlap_boxes.get(v),
+                                          params, riders)
         # detection-res -> full-res: average downsampling by f maps level
         # voxel p to full-res f*p + (f-1)/2 (DownsampleTools.correctForDownsampling)
         T = mipmap_transform(ds)
         full = apply_affine(T, pts) if len(pts) else pts
         det = ViewDetections(v, full, vals)
+        if want_desc:
+            det.descriptors, det.descriptor_valid = riders
         if params.store_intensities and len(pts):
             det.intensities = _sample_intensities(loader, plan, pts)
         out.append(det)
@@ -474,9 +597,12 @@ def detect_interest_points(
     return out
 
 
-def _filter_spots(pts, vals, boxes, params: DetectionParams):
+def _filter_spots(pts, vals, boxes, params: DetectionParams, riders=()):
     """overlappingOnly final crop + brightest-N filters
-    (filterPoints / maxSpotsPerOverlap, SparkInterestPointDetection.java:745-806,973-995)."""
+    (filterPoints / maxSpotsPerOverlap, SparkInterestPointDetection.java:745-806,973-995).
+    ``riders``: extra per-point arrays (descriptors, validity) that must
+    follow every mask/reorder applied to ``pts``/``vals``."""
+    riders = tuple(riders)
     if boxes is not None and len(pts):
         keep = np.zeros(len(pts), bool)
         for b in boxes:
@@ -485,6 +611,7 @@ def _filter_spots(pts, vals, boxes, params: DetectionParams):
             )
             keep |= inside
         pts, vals = pts[keep], vals[keep]
+        riders = tuple(r[keep] for r in riders)
     if params.max_spots > 0 and len(pts):
         if params.max_spots_per_overlap and boxes:
             total_vol = sum(b.num_elements for b in boxes)
@@ -502,10 +629,12 @@ def _filter_spots(pts, vals, boxes, params: DetectionParams):
                     idx = idx[order]
                 keep[idx] = True
             pts, vals = pts[keep], vals[keep]
+            riders = tuple(r[keep] for r in riders)
         elif len(pts) > params.max_spots:
             order = np.argsort(-np.abs(vals))[: params.max_spots]
             pts, vals = pts[order], vals[order]
-    return pts, vals
+            riders = tuple(r[order] for r in riders)
+    return pts, vals, riders
 
 
 def _sample_intensities(loader, plan: _ViewPlan, det_pts: np.ndarray,

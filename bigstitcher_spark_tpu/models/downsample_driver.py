@@ -29,7 +29,14 @@ def read_padded(src_read, src_shape, src_off, src_size) -> "np.ndarray":
     data = src_read([int(o) for o in src_off], clamped)
     if clamped != [int(s) for s in src_size]:
         pad = [(0, int(s) - c) for s, c in zip(src_size, clamped)]
-        data = np.pad(data, pad, mode="edge")
+        if isinstance(data, np.ndarray):
+            data = np.pad(data, pad, mode="edge")
+        else:
+            # device array (a streaming handoff read): pad on device, the
+            # bytes must not round-trip through the host here
+            import jax.numpy as jnp
+
+            data = jnp.pad(data, pad, mode="edge")
     return data
 
 
@@ -200,7 +207,14 @@ def downsample_pyramid_level(
 
         src_shape = src.shape[:3]
     else:
-        read3d, write3d, src_shape = src.read, dst.write, src.shape
+        def read3d(off, size):
+            # a streamed producer's device-resident blocks serve straight
+            # from HBM (zero D2H + zero container decode); None falls back
+            # to the gated host read
+            dev = src.read_device(off, size)
+            return dev if dev is not None else src.read(off, size)
+
+        write3d, src_shape = dst.write, src.shape
 
     def read_job(block: GridBlock):
         src_off = [o * f for o, f in zip(block.offset, rel)]

@@ -400,12 +400,45 @@ def solve_intensities(
     # intra-view smoothness: 6-neighborhood of each cell grid, propagating
     # corrections into cells without overlap matches
     smooth = smoothness_pairs(dims, len(views))
+    dev_sol: list = []
     sol = solve_intensity_coefficients(ncell * len(views), norm, lam,
-                                       smooth_pairs=smooth)
+                                       smooth_pairs=smooth,
+                                       on_device_solution=dev_sol.append)
     # un-normalize: f(i) = a*(i*s)/s + b/s... scale invariant: offsets scale
     out = {}
     for v in views:
         c = sol[base[v]: base[v] + ncell].copy()
         c[:, 1] /= s
         out[v] = c.reshape(*dims, 2)
+    if dev_sol:
+        _register_device_coefficients(dev_sol[0], out, views, base, ncell,
+                                      dims, s)
     return out
+
+
+def _register_device_coefficients(dev, out, views, base, ncell, dims, s):
+    """Mirror the host un-normalization ON DEVICE from the CG solver's
+    device output and register the per-view grids with the fusion
+    coefficient-table cache (models.affine_fusion.register_coefficient_table):
+    the solve→fusion coefficient path stays device-resident, so a
+    following fusion's first table lookup hits without the grids ever
+    making the host->device round trip. The float64 math is the same IEEE
+    sequence as the host branch above, so the registered table is
+    bit-identical to one rebuilt from ``out``."""
+    try:
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+
+        from .affine_fusion import register_coefficient_table
+
+        with enable_x64():
+            d = jnp.reshape(dev[: 2 * ncell * len(views)], (-1, 2))
+            per = {}
+            for v in views:
+                c = d[base[v]: base[v] + ncell]
+                c = jnp.concatenate([c[:, :1], c[:, 1:] / s], axis=1)
+                per[v] = jnp.reshape(c, (*dims, 2)).astype(jnp.float32)
+        register_coefficient_table(out, per)
+    except Exception as e:  # pragma: no cover - residency is best-effort
+        observe.log(f"device coefficient registration skipped: {e!r}",
+                    stage="solve-intensities")

@@ -152,6 +152,17 @@ METRICS: dict[str, str] = {
         "seconds producers stalled on block-exchange backpressure",
     "bst_dag_consumer_wait_seconds_total":
         "seconds consumers waited for input blocks not yet produced",
+    "bst_dag_handoff_blocks_total":
+        "producer chunks published DEVICE-resident into the HBM handoff "
+        "cache (skipping even the host decoded-chunk LRU)",
+    "bst_dag_handoff_bytes_served_total":
+        "streamed-edge bytes consumers read as device arrays straight "
+        "from the HBM handoff cache (zero D2H, zero container decode)",
+    "bst_dag_handoff_spill_bytes_total":
+        "handoff-cache bytes spilled to the host decoded-chunk LRU "
+        "(budget pressure, a host-side read, or the end-of-run flush)",
+    "bst_dag_handoff_bytes":
+        "device bytes currently resident in the HBM handoff cache",
     "bst_dag_stages_completed_total":
         "pipeline stages finished, labeled by terminal status",
     "bst_dag_containers_elided_total":
@@ -192,6 +203,9 @@ SPANS: dict[str, str] = {
         "container write of an epilogue pyramid slab or block",
     # detection / stitching / matching / nonrigid drivers
     "detection.kernel": "DoG + localization device computation",
+    "detection.extract":
+        "descriptor-extraction device dispatch of the STAGED two-pass "
+        "detect+extract path (absent when the fused program runs)",
     "stitching.extract": "overlap crop extraction for one pair batch",
     "stitching.kernel": "phase-correlation device program",
     "stitching.kernel_sync": "PCM device completion sync",
@@ -245,6 +259,15 @@ SPANS: dict[str, str] = {
         "a consumer stage blocked for input blocks not yet produced",
     "dag.stall": "a producer stage blocked on block-exchange backpressure",
     "dag.publish": "a producer published an output block (instant)",
+    "dag.handoff_publish":
+        "a producer published a block device-resident into the HBM "
+        "handoff cache (instant)",
+    "dag.handoff_read":
+        "a consumer's gated read assembled device-resident from the HBM "
+        "handoff cache (zero D2H)",
+    "dag.handoff_spill":
+        "handoff-cache chunks materialized to the host tier (eviction, "
+        "host read, or flush)",
     "dag.cleanup": "ephemeral intermediate-container cleanup",
     # telemetry-loop closer (tune/)
     "tune.advise": "one advisor pass over a recorded run's evidence",

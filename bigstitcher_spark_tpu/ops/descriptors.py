@@ -125,6 +125,69 @@ def build_descriptors(
     return desc.reshape(n * s, -1).astype(jnp.float32), owner
 
 
+def block_descriptors_impl(points, valid, n_neighbors: int = 3,
+                           redundancy: int = 1,
+                           rotation_invariant: bool = True):
+    """Per-point descriptors of one detection block's FIXED-K candidate
+    list (padded slots flagged by ``valid``) — the extract half of the
+    fused detect+extract program (ops.dog.dog_detect_extract_impl), where
+    the peaks never leave HBM between the DoG top-K and this.
+
+    Same subset/frame math as :func:`build_descriptors`; the kNN is
+    masked by VALIDITY instead of run on a dense cloud: invalid rows and
+    columns (and the diagonal) get +inf DISTANCE — the coordinates are
+    never poisoned, because an inf-inf arithmetic path would NaN the
+    distances and break top_k ordering. Invalid offsets are zeroed before
+    the frame math so padded slots produce deterministic all-zero
+    descriptors. Returns (desc (K, S, n_neighbors*3) float32,
+    dvalid (K,) bool); dvalid marks points with a full pool of valid
+    neighbors."""
+    k = int(points.shape[0])
+    pool = n_neighbors + redundancy
+    n_subs = len(subset_combinations(pool, n_neighbors))
+    if k <= pool:  # static: fewer candidate slots than a neighbor pool
+        return (jnp.zeros((k, n_subs, n_neighbors * 3), jnp.float32),
+                jnp.zeros((k,), bool))
+    p = points.astype(jnp.float32)
+    d2 = ((p[:, None, :] - p[None, :, :]) ** 2).sum(-1)       # (K, K)
+    pair_ok = valid[:, None] & valid[None, :]
+    d2 = jnp.where(pair_ok & ~jnp.eye(k, dtype=bool), d2, jnp.inf)
+    neg, idx = jax.lax.top_k(-d2, pool)                       # (K, pool)
+    dvalid = valid & (neg[:, -1] > -jnp.inf)  # pool-th neighbor is real
+    offs = p[idx] - p[:, None, :]                             # (K, pool, 3)
+    offs = jnp.where(dvalid[:, None, None], offs, 0.0)
+    subs = jnp.asarray(subset_combinations(pool, n_neighbors))
+    sel = offs[:, subs, :]                                    # (K, S, u, 3)
+    if rotation_invariant:
+        o0 = sel[..., 0, :]
+        o1 = sel[..., 1 % n_neighbors, :]
+        ex = o0 / (jnp.linalg.norm(o0, axis=-1, keepdims=True) + 1e-12)
+        ey = o1 - (o1 * ex).sum(-1, keepdims=True) * ex
+        ey = ey / (jnp.linalg.norm(ey, axis=-1, keepdims=True) + 1e-12)
+        ez = jnp.cross(ex, ey)
+        frame = jnp.stack([ex, ey, ez], axis=-1)
+        sel = jnp.einsum("nsji,nskj->nski", frame, sel)
+    desc = sel.reshape(k, -1, n_neighbors * 3).astype(jnp.float32)
+    return desc, dvalid
+
+
+def block_descriptors_batch_impl(points, valid, n_neighbors: int = 3,
+                                 redundancy: int = 1,
+                                 rotation_invariant: bool = True):
+    """vmapped :func:`block_descriptors_impl` over a leading batch axis.
+    Un-jitted so the mesh layer can wrap it with batch-axis shardings."""
+    return jax.vmap(
+        lambda pp, vv: block_descriptors_impl(
+            pp, vv, n_neighbors, redundancy, rotation_invariant)
+    )(points, valid)
+
+
+block_descriptors_batch = functools.partial(
+    jax.jit,
+    static_argnames=("n_neighbors", "redundancy", "rotation_invariant"),
+)(block_descriptors_batch_impl)
+
+
 @jax.jit
 def _pairwise_sqdist(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """(Na,Nb) squared euclidean distances via the matmul identity.

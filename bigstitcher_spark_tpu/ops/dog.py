@@ -377,6 +377,46 @@ dog_block_topk_batch = functools.partial(
 )(dog_block_topk_batch_impl)
 
 
+def dog_detect_extract_impl(block, min_i, max_i, threshold, origin, sigma,
+                            find_max=True, find_min=False, k=2048, halo=0,
+                            rel=(1, 1, 1), n_neighbors=3, redundancy=1,
+                            rotation_invariant=True):
+    """DoG detection + geometric descriptor extraction as ONE program:
+    the K candidate peaks never leave HBM between top-K/subpixel and the
+    kNN/frame math. Composes :func:`dog_block_topk_impl` with
+    ops.descriptors.block_descriptors_impl on the block-LOCAL subpixel
+    coords (descriptors are pure neighbor offsets, hence translation
+    invariant — adding the block origin later cannot change them).
+    Returns the topk 5-tuple plus (desc, dvalid)."""
+    from .descriptors import block_descriptors_impl
+
+    idx, sub, val, valid, count = dog_block_topk_impl(
+        block, min_i, max_i, threshold, origin, sigma, find_max, find_min,
+        k, halo, rel)
+    desc, dvalid = block_descriptors_impl(
+        sub, valid, n_neighbors, redundancy, rotation_invariant)
+    return idx, sub, val, valid, count, desc, dvalid
+
+
+def dog_detect_extract_batch_impl(blocks, min_i, max_i, threshold, origins,
+                                  sigma, find_max=True, find_min=False,
+                                  k=2048, halo=0, rel=(1, 1, 1),
+                                  n_neighbors=3, redundancy=1,
+                                  rotation_invariant=True):
+    return jax.vmap(
+        lambda b, lo, hi, t, o: dog_detect_extract_impl(
+            b, lo, hi, t, o, sigma, find_max, find_min, k, halo, rel,
+            n_neighbors, redundancy, rotation_invariant)
+    )(blocks, min_i, max_i, threshold, origins)
+
+
+dog_detect_extract_batch = functools.partial(
+    jax.jit,
+    static_argnames=("sigma", "find_max", "find_min", "k", "halo", "rel",
+                     "n_neighbors", "redundancy", "rotation_invariant"),
+)(dog_detect_extract_batch_impl)
+
+
 def localize_quadratic(
     dog: np.ndarray, coords: np.ndarray, max_moves: int = 4
 ) -> tuple[np.ndarray, np.ndarray]:

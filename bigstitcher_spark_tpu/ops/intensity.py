@@ -145,8 +145,15 @@ def solve_intensity_coefficients(
     smooth_pairs: list[tuple[int, int]] | None = None,
     smooth_weight: float = 0.5,
     backend: str | None = None,
+    on_device_solution=None,
 ) -> np.ndarray:
     """Global least squares over the coefficient graph.
+
+    ``on_device_solution``: optional callback handed the solver's
+    DEVICE-resident solution vector before the host fetch (device backend
+    only) — the solve→fusion residency hook: models.intensity reshapes it
+    on device and registers the result with the fusion coefficient-table
+    cache so the grids never re-cross H2D.
 
     Unknowns: per cell c a map f_c(i) = s_c*i + o_c (2*n_cells unknowns,
     cells indexed globally over all views). Each match contributes, for its
@@ -200,7 +207,8 @@ def solve_intensity_coefficients(
     backend = _dsolve.resolve_backend(backend)
     if backend == "device" and len(rows):
         return _solve_coefficients_device(
-            n_cells, rows, lam_eff, cell_xx, cell_n, smooth_arr, wxx, wn)
+            n_cells, rows, lam_eff, cell_xx, cell_n, smooth_arr, wxx, wn,
+            on_device_solution)
 
     A = np.zeros((2 * n_cells, 2 * n_cells))
     rhs = np.zeros(2 * n_cells)
@@ -242,7 +250,8 @@ def solve_intensity_coefficients(
 
 
 def _solve_coefficients_device(n_cells, rows, lam_eff, cell_xx, cell_n,
-                               smooth_arr, wxx, wn) -> np.ndarray:
+                               smooth_arr, wxx, wn,
+                               on_device_solution=None) -> np.ndarray:
     """Device CG path of :func:`solve_intensity_coefficients`: same
     regularizer/smoothness assembly, matrix-free matvec over the match
     rows inside one compiled while_loop (sharded + psum-reduced above
@@ -274,6 +283,8 @@ def _solve_coefficients_device(n_cells, rows, lam_eff, cell_xx, cell_n,
             n_cells, rows, diag, rhs, sidx, sw, n_shards)
     _metrics.counter("bst_solve_device_ms_total", stage="intensity").inc(
         (time.perf_counter() - t0) * 1000.0)
+    if on_device_solution is not None:
+        on_device_solution(out[0])  # device vector, pre-fetch
     with profiling.span("solve.reduce", stage="intensity"):
         sol, iters = jax.device_get(out)
     _metrics.counter("bst_solve_iterations_total", stage="intensity").inc(

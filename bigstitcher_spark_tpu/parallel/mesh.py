@@ -238,15 +238,44 @@ def _build_sharded_fuser(
 
 def pad_batch(arrays: Sequence[np.ndarray], batch: int) -> list[np.ndarray]:
     """Pad each stacked input along axis 0 to ``batch`` (extra entries are
-    all-zero => valid mask 0 => no-op blocks)."""
+    all-zero => valid mask 0 => no-op blocks). Device-resident inputs
+    (a streaming handoff edge feeding this stage) pad on device — they
+    must never round-trip through host memory here."""
     out = []
     for a in arrays:
         if a.shape[0] == batch:
             out.append(a)
+        elif isinstance(a, jax.Array):
+            import jax.numpy as jnp
+
+            pad = jnp.zeros((batch - a.shape[0],) + a.shape[1:], a.dtype)
+            out.append(jnp.concatenate([a, pad], axis=0))
         else:
             pad = np.zeros((batch - a.shape[0],) + a.shape[1:], a.dtype)
             out.append(np.concatenate([a, pad], axis=0))
     return out
+
+
+def stack_inputs(inputs: Sequence, j: int):
+    """Stack input ``j`` of every build result along a new batch axis —
+    on host for numpy inputs, ON DEVICE when any item arrived as a jax
+    array (a device-resident handoff read): ``np.stack`` over jax arrays
+    would silently device_get every one of them."""
+    parts = [inp[j] for inp in inputs]
+    if any(isinstance(p, jax.Array) for p in parts):
+        import jax.numpy as jnp
+
+        # handoff chunks arrive committed to their PRODUCER's device;
+        # stacking mixed placements is an error, so gather onto one
+        # device first (D2D for device parts). Host-origin parts of a
+        # mixed batch DO cross the wire — account them here, since the
+        # dispatch-side H2D counter sees only the final device stack.
+        dev0 = jax.local_devices()[0]
+        _H2D_BYTES.inc(sum(int(p.nbytes) for p in parts
+                           if not isinstance(p, jax.Array)))
+        return jnp.stack([jax.device_put(jnp.asarray(p), dev0)
+                          for p in parts])
+    return np.stack(parts)
 
 
 def run_sharded_batches(
@@ -263,6 +292,7 @@ def run_sharded_batches(
     out_bytes_per_item: int = 0,
     workspace_mult: float = 2.0,
     device_drain: bool = False,
+    device_consume=None,
 ):
     """The shared multi-device work loop: every sharded stage driver (fusion,
     detection, nonrigid, downsample) is this pattern — the TPU replacement of
@@ -310,7 +340,15 @@ def run_sharded_batches(
     device's chunk writes, and writers still own disjoint chunks (the
     no-shuffle invariant, now per device; ROADMAP item 3b). Callers must
     only enable it when ``consume`` tolerates ``n_dev``-way concurrency —
-    h5py-backed containers (single-writer) must keep the default path."""
+    h5py-backed containers (single-writer) must keep the default path.
+
+    ``device_consume(item, *device_rows) -> bool`` is an optional
+    pre-fetch hook: it sees each item's output rows as DEVICE arrays
+    before any D2H, and returning True claims the item — its rows are
+    never fetched and ``consume`` never runs for it (the streaming
+    handoff publish path: the row stays in HBM for the downstream
+    stage). Rows it declines are fetched lazily, so a batch it fully
+    claims does zero D2H."""
     from .retry import run_with_retry
 
     if multihost:
@@ -346,13 +384,22 @@ def run_sharded_batches(
         # blocks of which half are zero work (the jit re-specializes once
         # per distinct tail size; full batches all share one shape)
         stacked = pad_batch(
-            [np.stack([inp[j] for inp in inputs])
-             for j in range(len(inputs[0]))],
+            [stack_inputs(inputs, j) for j in range(len(inputs[0]))],
             -(-len(inputs) // max(n_dev, 1)) * max(n_dev, 1),
         )
+        if n_dev > 1 and any(isinstance(a, jax.Array) for a in stacked):
+            # a handoff-fed input is committed to ONE device; the sharded
+            # kernels pin batch-leading args to the block mesh, so re-place
+            # it there (same-mesh D2D — the bytes never revisit the host)
+            spread = NamedSharding(make_mesh(n_dev), P(BLOCK_AXIS))
+            stacked = [jax.device_put(a, spread) if isinstance(a, jax.Array)
+                       else a for a in stacked]
         nbytes = sum(a.nbytes for a in stacked)
-        _H2D_BYTES.inc(nbytes)
-        _H2D_SAVED.inc(narrow_dtype_savings(stacked))
+        # only HOST-origin inputs cross the wire: a device-stacked input
+        # (handoff-fed stage) contributes zero H2D
+        host = [a for a in stacked if not isinstance(a, jax.Array)]
+        _H2D_BYTES.inc(sum(a.nbytes for a in host))
+        _H2D_SAVED.inc(narrow_dtype_savings(host))
         outs = kernel(*stacked)
         outs = outs if isinstance(outs, (tuple, list)) else (outs,)
         cost = batch_cost(nbytes, n_items)
@@ -434,10 +481,12 @@ def run_sharded_batches(
         # fetch below only waits on THIS batch's buffers — a data
         # dependency)
         dispatch_ahead(bi)
+        keep = list(range(len(batch)))
         try:
             if drain_pool is not None:
-                _drain_per_device(outs, batch, consume, drain_pool, label, bi)
-            else:
+                _drain_per_device(outs, batch, consume, drain_pool, label, bi,
+                                  device_consume)
+            elif device_consume is None:
                 # device-array nbytes are free to read pre-fetch: the span
                 # carries the batch's wire payload for the trace-report D2H
                 # decomposition
@@ -445,16 +494,35 @@ def run_sharded_batches(
                 with profiling.span("mesh.d2h", stage=label, item=int(bi),
                                     nbytes=d2h_nbytes):
                     outs = jax.device_get(list(outs))  # pipelined batch fetch
+            else:
+                # handoff publish first: claimed rows stay in HBM and are
+                # never fetched; only the declined remainder crosses D2H
+                keep = [i for i, it in enumerate(batch)
+                        if not device_consume(it, *(o[i] for o in outs))]
+                if keep:
+                    rows = [[o[i] for i in keep] for o in outs]
+                    d2h_nbytes = sum(int(getattr(r, "nbytes", 0))
+                                     for rs in rows for r in rs)
+                    with profiling.span("mesh.d2h", stage=label, item=int(bi),
+                                        nbytes=d2h_nbytes):
+                        outs = jax.device_get(rows)
+                else:
+                    outs = None
         finally:
             # drained or dead, the buffers leave the ledger either way —
             # a fetch error must not shrink the window for the whole run
             window.release(cost)
-        if drain_pool is None:
-            _D2H_BYTES.inc(sum(int(getattr(o, "nbytes", 0)) for o in outs))
-            _D2H_SAVED.inc(narrow_dtype_savings(outs))
+        if drain_pool is None and outs is not None:
+            flat = (list(outs) if device_consume is None
+                    else [d for ds_ in outs for d in ds_])
+            _D2H_BYTES.inc(sum(int(getattr(d, "nbytes", 0)) for d in flat))
+            _D2H_SAVED.inc(narrow_dtype_savings(flat))
+            # with device_consume unset keep == range(len(batch)) and the
+            # outputs are whole batch arrays, so row k IS item gi; with it
+            # set the outputs were gathered per kept row
             wfuts = [
-                pool.submit(consume, it, *(o[i] for o in outs))
-                for i, it in enumerate(batch)
+                pool.submit(consume, batch[gi], *(o[k] for o in outs))
+                for k, gi in enumerate(keep)
             ]
             for w in wfuts:
                 w.result()
@@ -472,14 +540,18 @@ def run_sharded_batches(
             window.release(cost)  # keep the process-wide gauge honest
 
 
-def _drain_per_device(outs, batch, consume, drain_pool, label, bi):
+def _drain_per_device(outs, batch, consume, drain_pool, label, bi,
+                      device_consume=None):
     """Fetch + consume one dispatched batch with one drain worker per
     device shard. Shards are grouped by their batch-axis row start (the
     1-D block sharding is contiguous, so row start order == mesh device
     order); each worker fetches its device's shard of every output in one
     pipelined ``device_get`` and consumes exactly the rows that device
     computed, writes included. Errors propagate to the caller (the retry
-    layer re-runs the whole batch; chunk writes are idempotent)."""
+    layer re-runs the whole batch; chunk writes are idempotent).
+    ``device_consume`` (see run_sharded_batches) is offered each row as
+    device arrays before the shard fetch; a shard whose rows are all
+    claimed does zero D2H."""
     per_dev: dict[int, list] = {}
     for oi, o in enumerate(outs):
         shards = getattr(o, "addressable_shards", None) or []
@@ -494,17 +566,40 @@ def _drain_per_device(outs, batch, consume, drain_pool, label, bi):
         _DRAIN_TLS.device = di
         try:
             parts = per_dev[r0]
-            nb = sum(int(getattr(p, "nbytes", 0)) for p in parts)
-            with profiling.span("mesh.d2h", stage=label, item=int(bi),
-                                device=di, nbytes=nb):
-                datas = jax.device_get(parts)
-            _D2H_BYTES.inc(sum(int(getattr(d, "nbytes", 0)) for d in datas))
-            _D2H_SAVED.inc(narrow_dtype_savings(datas))
-            for li in range(int(datas[0].shape[0])):
+            if device_consume is None:
+                nb = sum(int(getattr(p, "nbytes", 0)) for p in parts)
+                with profiling.span("mesh.d2h", stage=label, item=int(bi),
+                                    device=di, nbytes=nb):
+                    datas = jax.device_get(parts)
+                _D2H_BYTES.inc(sum(int(getattr(d, "nbytes", 0))
+                                   for d in datas))
+                _D2H_SAVED.inc(narrow_dtype_savings(datas))
+                for li in range(int(datas[0].shape[0])):
+                    gi = r0 + li
+                    if gi >= len(batch):
+                        break    # batch-axis padding rows carry no work
+                    consume(batch[gi], *(d[li] for d in datas))
+                return
+            todo = []
+            for li in range(int(parts[0].shape[0])):
                 gi = r0 + li
                 if gi >= len(batch):
-                    break    # batch-axis padding rows carry no work
-                consume(batch[gi], *(d[li] for d in datas))
+                    break        # batch-axis padding rows carry no work
+                if device_consume(batch[gi], *(p[li] for p in parts)):
+                    continue     # claimed: the row stays in HBM
+                todo.append(li)
+            if not todo:
+                return           # whole shard claimed on device: zero D2H
+            rows = [[p[li] for li in todo] for p in parts]
+            nb = sum(int(getattr(r, "nbytes", 0)) for rs in rows for r in rs)
+            with profiling.span("mesh.d2h", stage=label, item=int(bi),
+                                device=di, nbytes=nb):
+                datas = jax.device_get(rows)
+            flat = [d for ds_ in datas for d in ds_]
+            _D2H_BYTES.inc(sum(int(getattr(d, "nbytes", 0)) for d in flat))
+            _D2H_SAVED.inc(narrow_dtype_savings(flat))
+            for k, li in enumerate(todo):
+                consume(batch[r0 + li], *(d[k] for d in datas))
         finally:
             _DRAIN_TLS.device = None
 

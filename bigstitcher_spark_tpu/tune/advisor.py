@@ -233,6 +233,66 @@ def _rule_dag_backpressure(rec, flat, trace_rep, wall):
                   "wall_seconds": round(wall or 0.0, 2)})
 
 
+_MIN_HANDOFF_BLOCKS = 8      # streamed blocks before handoff advice fires
+_SPILL_FRACTION = 0.25       # spilled vs served bytes that means undersized
+
+
+def _rule_dag_handoff_miss(rec, flat, trace_rep, wall):
+    """Same-mesh streamed edges resolving through the host chunk LRU (or
+    spilling out of HBM) while BST_DAG_HANDOFF_BYTES is off/undersized:
+    those blocks could have been served as device arrays — zero D2H and
+    zero container re-decode on the edge."""
+    streamed = _sum(flat, "bst_dag_blocks_streamed_total")
+    if streamed < _MIN_HANDOFF_BLOCKS:
+        return None
+    served = _sum(flat, "bst_dag_handoff_blocks_total")
+    served_b = _sum(flat, "bst_dag_handoff_bytes_served_total")
+    spilled = _sum(flat, "bst_dag_handoff_spill_bytes_total")
+    elided = _sum(flat, "bst_dag_bytes_elided_total")
+    budget, src = _recorded_budget(rec, "BST_DAG_HANDOFF_BYTES")
+    tun = config.KNOBS["BST_DAG_HANDOFF_BYTES"].tunable
+    lo = int(tun.lo) if tun and tun.lo is not None else 64 << 20
+    hi = int(tun.hi) if tun and tun.hi is not None else 8 << 30
+    if not budget:
+        if served > 0:   # enabled mid-run; nothing to advise
+            return None
+        # bound the suggestion by what actually flowed over streamed edges
+        want = int(min(hi, max(lo, elided)))
+        return Diagnosis(
+            rule="dag_handoff_miss",
+            detail=(f"{int(streamed)} same-mesh streamed blocks resolved "
+                    f"through the host chunk LRU with the HBM handoff "
+                    f"cache off — a bounded BST_DAG_HANDOFF_BYTES serves "
+                    f"them to consumers as device arrays (zero D2H, zero "
+                    f"re-decode on those edges)"),
+            confidence=0.7,
+            knob="BST_DAG_HANDOFF_BYTES",
+            suggested_value=str(want),
+            evidence={"blocks_streamed": int(streamed),
+                      "handoff_blocks": int(served),
+                      "bytes_elided": int(elided),
+                      "budget_source": src})
+    if spilled >= _SPILL_FRACTION * max(served_b, 1.0):
+        return Diagnosis(
+            rule="dag_handoff_miss",
+            detail=(f"{int(spilled)} handoff bytes spilled to the host "
+                    f"LRU vs {int(served_b)} served from device under the "
+                    f"{int(budget)}-byte HBM budget ({src}) — the handoff "
+                    f"working set does not fit; a larger budget keeps "
+                    f"those blocks device-resident"),
+            confidence=round(min(0.9, 0.4 + spilled
+                                  / max(served_b + spilled, 1.0)), 2),
+            knob="BST_DAG_HANDOFF_BYTES",
+            suggested_value=str(_clamped_double("BST_DAG_HANDOFF_BYTES",
+                                                budget)),
+            evidence={"spill_bytes": int(spilled),
+                      "served_bytes": int(served_b),
+                      "handoff_blocks": int(served),
+                      "budget_bytes": int(budget),
+                      "budget_source": src})
+    return None
+
+
 def _rule_relay_drops(rec, flat, trace_rep, wall):
     drops = _sum(flat, "bst_relay_dropped_total")
     sent = _sum(flat, "bst_relay_sent_total")
@@ -254,7 +314,8 @@ def _rule_relay_drops(rec, flat, trace_rep, wall):
 
 _RULES = (_rule_low_overlap, _rule_cold_buckets, _rule_chunk_cache,
           _rule_tile_cache, _rule_inflight_saturated,
-          _rule_dag_backpressure, _rule_relay_drops)
+          _rule_dag_backpressure, _rule_dag_handoff_miss,
+          _rule_relay_drops)
 
 
 def advise_record(rec: dict,

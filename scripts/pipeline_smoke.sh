@@ -49,4 +49,31 @@ print(f"[smoke] {s['blocks_streamed']} blocks streamed, "
 EOF
 )
 
+echo '[smoke] running streamed pipeline with the HBM handoff enabled ...'
+export BST_DAG_HANDOFF_BYTES=$((1 << 30))
+bst pipeline run --summary "$WORK/summary-handoff.json" "$WORK/pipeline.json"
+
+echo '[smoke] verifying handoff summary ...'
+(cd "$REPO" && $PYTHON - "$WORK/summary-handoff.json" <<'EOF'
+import json, sys
+s = json.load(open(sys.argv[1]))
+assert s["ok"], s
+# device-resident handoff traffic happened on at least one streamed edge,
+# and no handoff edge (nor any other streamed edge) re-read the container
+handoff = [e for e in s["edges"] if e.get("blocks_handoff", 0) > 0]
+assert handoff, s["edges"]
+assert s.get("blocks_handoff", 0) > 0, s
+# ... and a consumer was actually SERVED device arrays on one of them
+assert sum(e["bytes_handoff"] for e in handoff) > 0, handoff
+for e in handoff:
+    assert e["bytes_reread"] == 0, e
+assert s["bytes_reread"] == 0, s
+print(f"[smoke] handoff: {s['blocks_handoff']} blocks served from device "
+      f"({sum(e['bytes_handoff'] for e in handoff)} B), "
+      f"{sum(e['bytes_spilled'] for e in handoff)} B spilled, "
+      f"0 B re-read on handoff edges")
+EOF
+)
+unset BST_DAG_HANDOFF_BYTES
+
 echo '[smoke] ok'
