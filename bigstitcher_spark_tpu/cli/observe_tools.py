@@ -50,6 +50,14 @@ def _hit_ratio(stats: dict) -> str:
     return f"{h / (h + m) * 100:.1f}%" if h + m else "-"
 
 
+def _pair_util(util: dict) -> str:
+    """Device utilization of this rank's most recent pair-scheduler stage
+    ({stage: {util_pct, ...}} from the relay snapshot)."""
+    pcts = [v.get("util_pct") for v in util.values()
+            if isinstance(v, dict) and v.get("util_pct") is not None]
+    return f"{min(pcts):.0f}%" if pcts else "-"
+
+
 def _fetch(socket_path, url):
     """One (status, jobs) sample, over HTTP when --url, else the socket."""
     if url:
@@ -138,7 +146,8 @@ def _render_cluster(doc: dict) -> str:
         f"  stall timeout {col.get('stall_timeout_s')}s",
         "",
         f"{'HOST':<18} {'RANK':>4}  {'STATE':<9} {'AGE':>6} "
-        f"{'PROGRESS':<24} {'CACHE':>6} {'INFLIGHT-HW':>11} {'DROP':>5}",
+        f"{'PROGRESS':<24} {'CACHE':>6} {'PAIR':>6} "
+        f"{'INFLIGHT-HW':>11} {'DROP':>5}",
     ]
     for r in doc.get("ranks", []):
         p = r.get("progress") or {}
@@ -156,6 +165,7 @@ def _render_cluster(doc: dict) -> str:
             f"{r.get('host', '?'):<18} {r.get('process_index', '?'):>4}  "
             f"{state:<9} {r.get('age_s', '?'):>5}s {prog:<24} "
             f"{_hit_ratio(r.get('chunk_cache') or {}):>6} "
+            f"{_pair_util(r.get('pair_util') or {}):>6} "
             f"{_fmt_bytes(infl):>11} {dropn:>5}")
     if not doc.get("ranks"):
         lines.append("(no ranks connected yet — workers push when "
@@ -182,8 +192,8 @@ def top_cmd(socket_path, url, cluster, interval, once):
     stall state, cache hit ratios, and the in-flight byte high-water —
     refreshed every --interval seconds until Ctrl-C. With --cluster,
     shows the pod view instead: one row per relayed rank (host, heartbeat
-    age, stage progress, stall verdict, cache ratio, in-flight
-    high-water, relay drops)."""
+    age, stage progress, stall verdict, cache ratio, pair-scheduler
+    device utilization, in-flight high-water, relay drops)."""
     def frame() -> str:
         if cluster:
             return _render_cluster(_fetch_cluster(socket_path, url))

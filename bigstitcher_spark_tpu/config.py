@@ -186,6 +186,15 @@ _knob("BST_SOLVE_SHARD", "int", 500000,
       "pairsched cost-weighted placement, per-sweep segment moments "
       "reduced with psum over the 1-D solve mesh axis). Sharded and "
       "single-device solves are bit-identical. 0 disables sharding.")
+_knob("BST_SOLVE_GLOBAL", "str", "auto",
+      "Span the sharded solve's 1-D links axis across ALL processes' "
+      "devices instead of only the local ones (the global solve mesh). "
+      "auto enables it exactly when the jax world has >1 process; 1 "
+      "forces the global mesh (requires an initialized multi-process "
+      "runtime); 0 pins the solve mesh to local devices. Owner-tile row "
+      "grouping makes the cross-host psum exact, so global and "
+      "single-host solves are bit-identical.",
+      choices=("auto", "1", "0"))
 
 # -- multi-host runtime ----------------------------------------------------
 _knob("BST_COORDINATOR", "str", None,
@@ -200,6 +209,15 @@ _knob("BST_PROCESS_ID", "int", None,
 _knob("BST_DISTRIBUTED", "bool", False,
       "On autodetecting platforms (Cloud TPU pods, SLURM): let "
       "jax.distributed.initialize() discover the topology.")
+_knob("BST_PAIR_MULTIHOST", "str", "auto",
+      "Split the pair-parallel stages (stitching PCM, descriptor and "
+      "intensity matching) across the processes of a multi-host world "
+      "before the local LPT device placement. auto enables the split "
+      "exactly when the jax world has >1 process (every rank computes "
+      "its cost-weighted slice, results allgather back so every rank "
+      "returns the full list); 1 forces it; 0 keeps every rank "
+      "computing every pair.",
+      choices=("auto", "1", "0"))
 
 # -- telemetry -------------------------------------------------------------
 _knob("BST_TELEMETRY_DIR", "str", None,
@@ -292,6 +310,16 @@ _knob("BST_DAG_EXCHANGE_BYTES", "bytes", 256 << 20,
       "needs BST_CHUNK_CACHE_BYTES >= this budget, or evicted handoff "
       "chunks fall back to a container decode.",
       tunable=Tunable(lo=32 << 20, hi=8 << 30))
+_knob("BST_DAG_EXCHANGE_ADDR", "str", None,
+      "Comma-separated, rank-ordered host:port list of the cross-host "
+      "block-exchange endpoints (dag/exchange.py) — entry i is where "
+      "rank i serves the blocks its producer stages write. When set in "
+      "a multi-process world, `bst pipeline` runs multi-host: a "
+      "consumer stage on one rank can read an edge produced on another "
+      "(the gated read fetches the covering chunks once over TCP into "
+      "the local decoded-chunk LRU, accounted as "
+      "bst_dag_xhost_bytes_total). Unset, pipelines stay single-process "
+      "and remote edges are an error.")
 _knob("BST_DAG_HANDOFF_BYTES", "bytes", 0,
       "Byte budget of the DEVICE-resident (HBM) handoff cache between a "
       "streaming pipeline's producer and consumer stages (dag/stream.py): "

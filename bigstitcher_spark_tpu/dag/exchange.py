@@ -1,0 +1,478 @@
+"""Cross-host block exchange: rank-addressed chunk transport for the DAG.
+
+The stream registry (dag/stream.py) gates consumer reads on producer
+block completions — but its coverage map and decoded-chunk handoff live
+in ONE process. A multi-process pipeline run (every rank executing the
+same spec SPMD, each stage taking its deterministic slice of the block
+grid) therefore needs three things this module provides, riding the
+relay's line-JSON TCP framing (observe/relay.py, PR 15):
+
+- **coverage broadcast** — a producer's published chunk positions are
+  pushed to every peer rank (``cover`` messages), so a remote consumer's
+  gate releases the moment the block lands anywhere in the world.
+  Stage-terminal ``done`` messages extend the producers-finished release
+  the same way: a gate only falls through to "the data is what the
+  container holds" once every rank's instance of the producer is
+  terminal.
+- **chunk fetch** — a consumer whose needed chunk is owned by a remote
+  rank pulls it ONCE over TCP (``fetch`` request, header line + raw
+  bytes reply) into the local decoded-chunk LRU; the read then resolves
+  via the cache exactly like a local handoff (zero container decode,
+  accounted ``bst_dag_xhost_bytes_total``).
+- **failure containment** — a peer whose connection dies without a
+  ``bye`` is declared dead; gates waiting on its blocks raise instead of
+  hanging, so only the downstream cone of the streamed edge fails while
+  independent branches run to completion. Push queues are BOUNDED: a
+  slow peer backpressures the producing rank (counted in
+  ``bst_dag_xhost_stall_seconds_total``), it never drops a cover
+  message (dropping one would wedge a remote gate forever).
+
+Addressing is static and rank-ordered: ``BST_DAG_EXCHANGE_ADDR`` holds a
+comma-separated ``host:port`` list where entry *i* is the endpoint rank
+*i* serves. Same trust model as the telemetry relay: plain TCP, no auth,
+pod-internal networks only.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import queue as _queuemod
+import socket
+import threading
+import time
+
+import numpy as np
+
+from .. import config, profiling
+from ..observe import metrics as _metrics
+from ..observe.relay import _set_keepalive, _shutdown_close
+from ..utils import cancel as _cancel
+
+SCHEMA = "bst-xhost/1"
+
+_FETCHES = _metrics.counter("bst_dag_xhost_fetches_total")
+_FETCH_BYTES = _metrics.counter("bst_dag_xhost_bytes_total")
+_SERVED_BYTES = _metrics.counter("bst_dag_xhost_served_bytes_total")
+_STALL = _metrics.counter("bst_dag_xhost_stall_seconds_total")
+_PEERS = _metrics.gauge("bst_dag_xhost_peers_connected")
+
+# push-queue tick while blocked on a full peer queue: long enough to be
+# free, short enough that cancellation stays responsive
+_TICK_S = 0.2
+# one fetch round trip (request + decode + reply) must finish within
+# this, or the peer is treated as gone for THIS fetch and retried once
+_FETCH_TIMEOUT_S = 30.0
+
+
+class ExchangeError(RuntimeError):
+    """A peer rank died or the exchange cannot serve a required chunk."""
+
+
+def parse_addresses(spec: str) -> list[tuple[str, int]]:
+    """``host:port,host:port,...`` -> rank-ordered endpoint list."""
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, sep, port = part.rpartition(":")
+        if not sep:
+            raise ValueError(
+                f"BST_DAG_EXCHANGE_ADDR wants host:port entries, got "
+                f"{part!r}")
+        out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
+def _send_line(sock: socket.socket, msg: dict) -> None:
+    sock.sendall((json.dumps(msg) + "\n").encode())
+
+
+def _recv_exact(f, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        piece = f.read(n - len(buf))
+        if not piece:
+            raise ExchangeError("peer closed mid-payload")
+        buf += piece
+    return bytes(buf)
+
+
+class _Peer:
+    """The outbound side toward ONE remote rank: a bounded push queue
+    drained by a sender thread (cover/done broadcasts, backoff
+    reconnect) plus a lock-guarded request/reply connection for chunk
+    fetches. Neither connection is opened until first use."""
+
+    def __init__(self, rank: int, address: tuple[str, int],
+                 my_rank: int, queue_max: int):
+        self.rank = rank
+        self.address = address
+        self.my_rank = my_rank
+        self._q: _queuemod.Queue = _queuemod.Queue(maxsize=queue_max)
+        self._stop = threading.Event()
+        self._sock: socket.socket | None = None
+        self._backoff = 1.0
+        self._fetch_lock = threading.Lock()
+        self._fetch_sock: socket.socket | None = None
+        self._fetch_file = None
+        self._thread = threading.Thread(
+            target=self._run, name=f"bst-xhost-peer-{rank}", daemon=True)
+        self._thread.start()
+
+    # -- push side (cover / done broadcasts) --------------------------------
+
+    def push(self, msg: dict) -> None:
+        """Enqueue one broadcast. A full queue BLOCKS (counted stall):
+        cover messages are correctness, not telemetry — dropping one
+        would leave a remote gate waiting forever."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(msg, timeout=_TICK_S)
+                return
+            except _queuemod.Full:
+                _STALL.inc(_TICK_S)
+                _cancel.check("xhost push")
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = self._q.get(timeout=0.5)
+            except _queuemod.Empty:
+                continue
+            while not self._stop.is_set():
+                sock = self._connect()
+                if sock is None:
+                    time.sleep(min(self._backoff, 1.0))
+                    continue
+                try:
+                    _send_line(sock, msg)
+                    break
+                except OSError:
+                    self._close()
+        # drain best-effort on shutdown so the goodbye (and any final
+        # covers) reach a still-listening peer instead of being dropped
+        while True:
+            try:
+                msg = self._q.get_nowait()
+            except _queuemod.Empty:
+                break
+            sock = self._connect()
+            if sock is None:
+                break
+            try:
+                _send_line(sock, msg)
+            except OSError:
+                break
+        self._close()
+        self._close_fetch()
+
+    def _connect(self) -> socket.socket | None:
+        if self._sock is not None:
+            return self._sock
+        sock = self._open()
+        if sock is None:
+            return None
+        self._sock = sock
+        return sock
+
+    def _open(self) -> socket.socket | None:
+        try:
+            sock = socket.create_connection(self.address, timeout=5.0)
+        except OSError:
+            self._backoff = min(self._backoff * 2, 5.0)
+            return None
+        self._backoff = 1.0
+        sock.settimeout(10.0)
+        _set_keepalive(sock)
+        try:
+            _send_line(sock, {"t": "hello", "schema": SCHEMA,
+                              "rank": self.my_rank})
+        except OSError:
+            _shutdown_close(sock)
+            return None
+        return sock
+
+    def _close(self) -> None:
+        if self._sock is not None:
+            _shutdown_close(self._sock)
+            self._sock = None
+
+    # -- fetch side (request/reply) -----------------------------------------
+
+    def fetch(self, root: str, path: str, pos: tuple) -> np.ndarray:
+        """Pull one decoded chunk from this peer. Retries a broken
+        connection once (the peer may have restarted between covers);
+        a second failure raises :class:`ExchangeError`."""
+        last: Exception | None = None
+        for _ in range(2):
+            try:
+                return self._fetch_once(root, path, pos)
+            except (OSError, ExchangeError) as e:
+                last = e
+                self._close_fetch()
+                if isinstance(e, ExchangeError) and "peer error" in str(e):
+                    break   # the peer answered; retrying will not help
+        raise ExchangeError(
+            f"fetch of {path}:{pos} from rank {self.rank} "
+            f"({self.address[0]}:{self.address[1]}) failed: {last}")
+
+    def _fetch_once(self, root, path, pos) -> np.ndarray:
+        with self._fetch_lock:
+            if self._fetch_sock is None:
+                sock = socket.create_connection(self.address, timeout=5.0)
+                sock.settimeout(_FETCH_TIMEOUT_S)
+                _set_keepalive(sock)
+                _send_line(sock, {"t": "hello", "schema": SCHEMA,
+                                  "rank": self.my_rank})
+                self._fetch_sock = sock
+                self._fetch_file = sock.makefile("rb")
+            _send_line(self._fetch_sock, {
+                "t": "fetch", "root": root, "path": path,
+                "pos": list(pos)})
+            line = self._fetch_file.readline()
+            if not line:
+                raise ExchangeError("peer closed during fetch")
+            head = json.loads(line)
+            if not head.get("ok"):
+                raise ExchangeError(f"peer error: {head.get('error')}")
+            raw = _recv_exact(self._fetch_file, int(head["nbytes"]))
+        arr = np.frombuffer(raw, dtype=np.dtype(head["dtype"]))
+        return arr.reshape(tuple(head["shape"])).copy()
+
+    def _close_fetch(self) -> None:
+        with self._fetch_lock:
+            if self._fetch_file is not None:
+                with contextlib.suppress(OSError):
+                    self._fetch_file.close()
+                self._fetch_file = None
+            if self._fetch_sock is not None:
+                _shutdown_close(self._fetch_sock)
+                self._fetch_sock = None
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._close_fetch()
+
+
+class Exchange:
+    """One rank's exchange endpoint: the server every peer pushes to and
+    fetches from, plus one :class:`_Peer` per remote rank. ``registry``
+    is the stream registry the server applies remote state to (the
+    process singleton in production; tests wire private registries to
+    simulate a world inside one process)."""
+
+    def __init__(self, rank: int, addresses, registry=None,
+                 queue_max: int | None = None):
+        from . import stream as _stream
+
+        self.rank = int(rank)
+        self.addresses = list(addresses)
+        if not (0 <= self.rank < len(self.addresses)):
+            raise ValueError(
+                f"exchange rank {rank} outside the {len(self.addresses)}"
+                f"-entry BST_DAG_EXCHANGE_ADDR list")
+        self.registry = registry if registry is not None \
+            else _stream.registry()
+        qmax = max(8, queue_max if queue_max is not None
+                   else config.get_int("BST_RELAY_QUEUE") or 256)
+        self._peers = {r: _Peer(r, a, self.rank, qmax)
+                       for r, a in enumerate(self.addresses)
+                       if r != self.rank}
+        self._stop = threading.Event()
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        host, port = self.addresses[self.rank]
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("" if host in ("", "0.0.0.0") else host, port))
+        srv.listen(16)
+        srv.settimeout(0.5)
+        self._server = srv
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="bst-xhost-server", daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def world(self) -> int:
+        return len(self.addresses)
+
+    # -- broadcasts (producer side) -----------------------------------------
+
+    def broadcast_cover(self, root: str, path: str, positions,
+                        per: int) -> None:
+        msg = {"t": "cover", "rank": self.rank, "root": root,
+               "path": path, "pos": [list(p) for p in positions],
+               "per": int(per)}
+        for p in self._peers.values():
+            p.push(msg)
+
+    def broadcast_done(self, stage_id: str, ok: bool = True) -> None:
+        msg = {"t": "done", "rank": self.rank, "stage": stage_id,
+               "ok": bool(ok)}
+        for p in self._peers.values():
+            p.push(msg)
+
+    # -- fetch (consumer side) ----------------------------------------------
+
+    def fetch(self, rank: int, root: str, path: str,
+              pos: tuple) -> np.ndarray:
+        peer = self._peers.get(int(rank))
+        if peer is None:
+            raise ExchangeError(f"no exchange peer for rank {rank}")
+        with profiling.span("dag.xhost_fetch", item=f"rank{rank}",
+                            stage=path):
+            arr = peer.fetch(root, path, tuple(int(x) for x in pos))
+        _FETCHES.inc()
+        _FETCH_BYTES.inc(int(arr.nbytes))
+        return arr
+
+    # -- server side ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.settimeout(None)
+            _set_keepalive(conn)
+            with self._conns_lock:
+                self._conns.add(conn)
+            _PEERS.set(len(self._conns))
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="bst-xhost-conn", daemon=True).start()
+        with contextlib.suppress(OSError):
+            self._server.close()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        """One peer connection: line-JSON requests, dispatched to the
+        registry. A connection that drops WITHOUT a ``bye`` from a rank
+        that said hello marks that rank dead — its blocks will never
+        arrive, and every gate waiting on them must fail rather than
+        hang."""
+        rank: int | None = None
+        clean = False
+        f = conn.makefile("rb")
+        try:
+            for line in f:
+                if self._stop.is_set():
+                    clean = True
+                    break
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                t = msg.get("t")
+                if t == "hello":
+                    rank = int(msg.get("rank", -1))
+                elif t == "cover":
+                    self.registry.remote_cover(
+                        msg["root"], msg["path"],
+                        [tuple(int(x) for x in p) for p in msg["pos"]],
+                        int(msg["rank"]), int(msg.get("per", 1)))
+                elif t == "done":
+                    self.registry.remote_done(msg["stage"],
+                                              int(msg["rank"]),
+                                              bool(msg.get("ok", True)))
+                elif t == "fetch":
+                    self._serve_fetch(conn, msg)
+                elif t == "bye":
+                    clean = True
+                    break
+        except (OSError, ValueError):
+            pass
+        finally:
+            with contextlib.suppress(OSError):
+                f.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
+            _PEERS.set(len(self._conns))
+            _shutdown_close(conn)
+            if rank is not None and not clean and not self._stop.is_set():
+                self.registry.remote_rank_dead(rank)
+
+    def _serve_fetch(self, conn: socket.socket, msg: dict) -> None:
+        pos = tuple(int(x) for x in msg["pos"])
+        with profiling.span("dag.xhost_serve", stage=str(msg["path"])):
+            try:
+                arr = self.registry.serve_chunk(
+                    str(msg["root"]), str(msg["path"]), pos)
+            except Exception as e:   # noqa: BLE001 — reply, don't die
+                arr, err = None, repr(e)
+            else:
+                err = f"no chunk {msg['path']}:{pos} on rank {self.rank}"
+        if arr is None:
+            _send_line(conn, {"t": "chunk", "ok": False, "error": err})
+            return
+        arr = np.ascontiguousarray(arr)
+        _send_line(conn, {"t": "chunk", "ok": True,
+                          "dtype": arr.dtype.str, "shape": list(arr.shape),
+                          "nbytes": int(arr.nbytes)})
+        conn.sendall(arr.tobytes())
+        _SERVED_BYTES.inc(int(arr.nbytes))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def stop(self) -> None:
+        if self._stop.is_set():
+            return
+        for p in self._peers.values():
+            p.push({"t": "bye", "rank": self.rank})
+        self._stop.set()
+        for p in self._peers.values():
+            p.stop()
+        with contextlib.suppress(OSError):
+            self._server.close()
+        self._accept_thread.join(timeout=5.0)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            _shutdown_close(c)
+
+
+# -- process singleton --------------------------------------------------------
+
+_STARTED: list[Exchange | None] = [None]
+_START_LOCK = threading.Lock()
+
+
+def configured() -> bool:
+    return bool(config.get_str("BST_DAG_EXCHANGE_ADDR"))
+
+
+def ensure_started() -> Exchange | None:
+    """The process-wide exchange for this rank, started on first call.
+    None when ``BST_DAG_EXCHANGE_ADDR`` is unset or the jax world is a
+    single process (nothing to exchange with). Raises when the address
+    list is shorter than the world — a rank without an endpoint cannot
+    participate."""
+    spec = config.get_str("BST_DAG_EXCHANGE_ADDR")
+    if not spec:
+        return None
+    from ..parallel.distributed import world
+
+    pi, pc = world()
+    if pc <= 1:
+        return None
+    with _START_LOCK:
+        if _STARTED[0] is not None:
+            return _STARTED[0]
+        addrs = parse_addresses(spec)
+        if len(addrs) < pc:
+            raise ExchangeError(
+                f"BST_DAG_EXCHANGE_ADDR lists {len(addrs)} endpoint(s) "
+                f"for a {pc}-process world")
+        _STARTED[0] = Exchange(pi, addrs[:pc])
+        return _STARTED[0]
+
+
+def shutdown() -> None:
+    with _START_LOCK:
+        x, _STARTED[0] = _STARTED[0], None
+    if x is not None:
+        x.stop()

@@ -21,10 +21,20 @@ one warm mesh and one set of process-wide caches:
   the coordination loop, so cancelling the daemon job poisons every
   stage.
 
-The executor is single-process by design (the block exchange and the
-``memory://`` elision live in process memory); multi-host pipelines run
-each stage's existing multi-host path INSIDE one process per host, which
-this executor does not orchestrate.
+With ``BST_DAG_EXCHANGE_ADDR`` set (dag/exchange.py) the executor also
+runs MULTI-process: every rank executes the same spec SPMD — each stage
+takes its deterministic slice of the work through the existing
+multi-host paths (parallel/distributed.py) — while block coverage,
+producer-done state and remote-owned chunks replicate between ranks
+over the rank-addressed exchange, so a producer on one rank feeds a
+consumer on another at block granularity. Ranks share one run id (rank
+0's, allgathered) so elided roots resolve identically, and enter/leave
+the run through barriers so no rank tears down containers a peer still
+fetches from. Stages that issue collectives (resave/fusion barriers,
+the pair-split allgather, the global solve) must not run concurrently
+with each other — sequence them with ``after`` edges; the canonical
+specs already do. Without the knob, a multi-process world is rejected
+exactly as before.
 """
 
 from __future__ import annotations
@@ -97,6 +107,8 @@ class PipelineResult:
             "kept_intermediates": self.kept_intermediates,
             "bytes_elided": sum(e["bytes_elided"] for e in self.edges),
             "bytes_reread": sum(e["bytes_reread"] for e in self.edges),
+            "bytes_xhost": sum(e.get("bytes_xhost", 0)
+                               for e in self.edges),
             "blocks_streamed": sum(e["blocks_streamed"]
                                    for e in self.edges),
             "blocks_handoff": sum(e["blocks_handoff"] for e in self.edges),
@@ -143,9 +155,12 @@ def _remove_container(root: str) -> None:
 
 
 class _Executor:
-    def __init__(self, spec: PipelineSpec, run_id: str):
+    def __init__(self, spec: PipelineSpec, run_id: str, rank: int = 0,
+                 world: int = 1):
         self.spec = spec
         self.run_id = run_id
+        self.rank = rank
+        self.world = world
         self._lock = threading.Lock()
         self._changed = threading.Condition(self._lock)
         self.runs = {
@@ -184,6 +199,17 @@ class _Executor:
 
     # -- stage thread -------------------------------------------------------
 
+    def _owners(self, run: StageRun) -> set[int] | None:
+        """Peer ranks a pinned stage runs on, or None when this rank runs
+        it itself (unpinned stage, owner rank, or single-process world —
+        ``ranks`` is a multi-host concern only)."""
+        if not run.spec.ranks or self.world <= 1:
+            return None
+        owners = {r for r in run.spec.ranks if r < self.world}
+        if not owners or self.rank in owners:
+            return None
+        return owners
+
     def _run_stage(self, run: StageRun) -> None:
         import click
 
@@ -192,16 +218,26 @@ class _Executor:
             with _cancel.scope(run.cancel), \
                     stream.stage_scope(run.token), \
                     profiling.span("dag.stage", stage=run.spec.id):
-                rc = _invoke_tool(run.spec.tool, run.spec.args)
-                if rc != 0:
-                    state, err = FAILED, f"exit code {rc}"
+                owners = self._owners(run)
+                if owners is not None:
+                    # rank-pinned stage owned elsewhere: adopt the
+                    # owners' outcome from their exchange broadcasts
+                    if not stream.registry().wait_remote_done(
+                            run.spec.id, owners):
+                        state, err = FAILED, (
+                            f"rank-pinned stage failed on peer rank(s) "
+                            f"{sorted(owners)}")
+                else:
+                    rc = _invoke_tool(run.spec.tool, run.spec.args)
+                    if rc != 0:
+                        state, err = FAILED, f"exit code {rc}"
         except _cancel.Cancelled:
             state, err = CANCELLED, "cancelled"
         except click.ClickException as e:
             state, err = FAILED, e.format_message()
         except BaseException as e:  # noqa: BLE001 — stage crash isolation
             state, err = FAILED, repr(e)[:500]
-        stream.registry().stage_finished(run.token)
+        stream.registry().stage_finished(run.token, ok=(state == DONE))
         with self._changed:
             run.state = state
             run.error = err
@@ -225,7 +261,7 @@ class _Executor:
                 d.error = f"upstream {sid} failed/cancelled"
                 d.finished_at = time.time()
                 _STAGES_DONE[CANCELLED].inc()
-                stream.registry().stage_finished(d.token)
+                stream.registry().stage_finished(d.token, ok=False)
 
     # -- coordination loop --------------------------------------------------
 
@@ -241,7 +277,8 @@ class _Executor:
                         run.error = "upstream failed/cancelled"
                         run.finished_at = time.time()
                         _STAGES_DONE[CANCELLED].inc()
-                        stream.registry().stage_finished(run.token)
+                        stream.registry().stage_finished(run.token,
+                                                         ok=False)
                         continue
                     if self._eligible_locked(run):
                         run.state = RUNNING
@@ -267,7 +304,8 @@ class _Executor:
                             run.error = "pipeline cancelled"
                             run.finished_at = time.time()
                             _STAGES_DONE[CANCELLED].inc()
-                            stream.registry().stage_finished(run.token)
+                            stream.registry().stage_finished(run.token,
+                                                             ok=False)
         for th in threads:
             th.join()
 
@@ -290,17 +328,28 @@ def run_pipeline(spec: PipelineSpec | dict | str, *,
         spec.validate()
     workdir = os.path.abspath(workdir or os.getcwd())
 
-    from ..parallel.distributed import world
+    from ..parallel import distributed as _dist
 
-    if world()[1] > 1:
-        raise SpecError(
-            "bst pipeline is single-process: the block exchange and "
-            "memory:// elision live in process memory (run the one-shot "
-            "tools for multi-host work)")
+    xch = None
+    if _dist.world()[1] > 1:
+        from . import exchange as _exchange
+
+        xch = _exchange.ensure_started()
+        if xch is None:
+            raise SpecError(
+                "bst pipeline needs the cross-host block exchange to run "
+                "multi-process: set BST_DAG_EXCHANGE_ADDR (one host:port "
+                "per rank) to execute the spec SPMD across ranks, or run "
+                "the one-shot tools")
 
     run_id = _new_run_id()
+    if xch is not None:
+        # every rank must resolve IDENTICAL elided roots and temp dirs —
+        # the exchange keys coverage on them; rank 0's id wins
+        run_id = _dist.allgather_object(run_id)[0]
     spec.resolve(workdir, keep_intermediates, run_id)
-    ex = _Executor(spec, run_id)
+    rank, world = _dist.world() if xch is not None else (0, 1)
+    ex = _Executor(spec, run_id, rank=rank, world=world)
 
     edges = []
     for name, ds in spec.datasets.items():
@@ -322,6 +371,11 @@ def run_pipeline(spec: PipelineSpec | dict | str, *,
 
     reg = stream.registry()
     reg.register(edges)
+    if xch is not None:
+        reg.set_exchange(xch)
+        # no rank may start producing (and broadcasting covers) into a
+        # world where a peer has not yet registered its edges
+        _dist.barrier("dag-start")
     t0 = time.time()
     observe.log(f"pipeline {spec.name}: {len(spec.stages)} stages, "
                 f"{len(edges)} edges "
@@ -330,6 +384,14 @@ def run_pipeline(spec: PipelineSpec | dict | str, *,
     try:
         ex.run()
     finally:
+        if xch is not None:
+            # peers may still be fetching this rank's chunks: hold the
+            # containers and the serve index until every rank's stages
+            # are terminal, then detach (clearing remote state)
+            try:
+                _dist.barrier("dag-end")
+            finally:
+                reg.set_exchange(None)
         reg.unregister(edges)
         # ephemeral lifecycle: cleaned on success AND on failure/cancel —
         # a half-written elided tree must never outlive its run

@@ -36,6 +36,11 @@ A spec is plain JSON (or the equivalent Python dicts / dataclasses):
 - ``after`` adds explicit barrier edges with no dataset (e.g. a stage
   that needs a file a predecessor writes outside any container, like a
   rewired XML).
+- ``ranks`` pins a stage to specific process ranks in a multi-host run
+  (e.g. ``"ranks": [0]`` for metadata-only container creation, which
+  must not race across ranks). Non-owner ranks skip the tool and adopt
+  the owners' outcome from their ``done`` broadcasts over the block
+  exchange. Single-process runs ignore the field.
 """
 
 from __future__ import annotations
@@ -83,6 +88,7 @@ class StageSpec:
     after: list[str] = field(default_factory=list)
     reads: list[str] = field(default_factory=list)
     writes: list[str] = field(default_factory=list)
+    ranks: list[int] = field(default_factory=list)  # empty = every rank
 
 
 @dataclass
@@ -120,6 +126,7 @@ class PipelineSpec:
                 after=[str(a) for a in (s.get("after") or [])],
                 reads=[str(a) for a in (s.get("reads") or [])],
                 writes=[str(a) for a in (s.get("writes") or [])],
+                ranks=[int(r) for r in (s.get("ranks") or [])],
             ))
         spec = PipelineSpec(name=str(d.get("name") or "pipeline"),
                             stages=stages, datasets=datasets)
@@ -165,6 +172,9 @@ class PipelineSpec:
                                     f"{ref!r} in after")
                 if ref == s.id:
                     raise SpecError(f"stage {s.id!r} lists itself in after")
+            if any(r < 0 for r in s.ranks):
+                raise SpecError(f"stage {s.id!r}: ranks must be "
+                                f"non-negative, got {s.ranks}")
             for name in [*s.reads, *s.writes]:
                 if name not in self.datasets:
                     raise SpecError(f"stage {s.id!r}: undeclared dataset "
@@ -327,11 +337,14 @@ def example_spec(xml: str, prefix: str = "pipeline") -> dict:
              "writes": ["resaved"]},
             # barrier on resave: the rewired XML is written when the
             # resave commits (it is a file, not a container edge)
+            # metadata-only container creation must not race across
+            # ranks in a multi-host run — pin it to rank 0 (no-op when
+            # single-process)
             {"id": "create", "tool": "create-fusion-container",
              "args": ["-x", rexml, "-o", "@fused", "-s", "N5",
                       "-d", "UINT16", "--minIntensity", "0",
                       "--maxIntensity", "65535"],
-             "after": ["resave"]},
+             "after": ["resave"], "ranks": [0]},
             {"id": "fuse", "tool": "affine-fusion",
              "args": ["-o", "@fused"],
              "after": ["create"], "reads": ["resaved"],

@@ -42,6 +42,15 @@ Per streamed edge (a named dataset with producer and consumer stages):
   edge and in the ``bst_dag_*`` process metrics, so `bst trace-report`
   and the bench ``pipeline`` extra can show exactly how many
   intermediate bytes never made the round trip.
+- **cross-host edges** — with the rank-addressed block exchange
+  attached (dag/exchange.py, ``BST_DAG_EXCHANGE_ADDR``), coverage and
+  producer-done state replicate across every rank of a multi-process
+  run: a remote rank's publish releases local gates, a remote-owned
+  chunk is fetched once over TCP into the local decoded-chunk LRU
+  (accounted ``bst_dag_xhost_bytes_total``) so the gated read still
+  elides the container, and a peer that dies without saying goodbye
+  fails exactly the gates waiting on its blocks — only the downstream
+  cone of the streamed edge poisons, independent branches finish.
 
 Everything here is inert until the executor registers edges: outside a
 pipeline run the chunkstore hot paths pay one list-load.
@@ -65,6 +74,7 @@ from ..io.uris import has_scheme
 from ..observe import metrics as _metrics
 from ..observe import trace as _trace
 from ..utils import cancel as _cancel
+from .exchange import ExchangeError
 
 _BLOCKS = _metrics.counter("bst_dag_blocks_streamed_total")
 _ELIDED = _metrics.counter("bst_dag_bytes_elided_total")
@@ -147,6 +157,7 @@ class EdgeState:
         self.bytes_published = 0
         self.bytes_elided = 0
         self.bytes_reread = 0
+        self.bytes_xhost = 0
         self.blocks_handoff = 0
         self.bytes_handoff = 0
         self.bytes_spilled = 0
@@ -163,6 +174,7 @@ class EdgeState:
             "bytes_published": self.bytes_published,
             "bytes_elided": self.bytes_elided,
             "bytes_reread": self.bytes_reread,
+            "bytes_xhost": self.bytes_xhost,
             "blocks_handoff": self.blocks_handoff,
             "bytes_handoff": self.bytes_handoff,
             "bytes_spilled": self.bytes_spilled,
@@ -324,6 +336,13 @@ class StreamRegistry:
         self._exchange_bytes = 0
         self._gate_waiters = 0
         self._handoff = _HandoffCache()
+        # cross-host state (only populated while an Exchange is attached)
+        self._exchange = None                       # dag.exchange.Exchange
+        self._remote_cov: dict[tuple, dict] = {}    # (root,path)->{pos:rank}
+        self._remote_done: dict[str, set] = {}      # stage id -> peer ranks
+        self._remote_failed: set[str] = set()       # failed on some peer
+        self._dead_ranks: set[int] = set()
+        self._datasets: dict[tuple, object] = {}    # (root,path) -> Dataset
 
     # -- lifecycle (executor side) -----------------------------------------
 
@@ -355,16 +374,26 @@ class StreamRegistry:
                 for key in [k for k in self._pending if k[0] == e.root]:
                     nbytes, _ = self._pending.pop(key)
                     self._exchange_bytes -= nbytes
+                for key in [k for k in self._remote_cov if k[0] == e.root]:
+                    del self._remote_cov[key]
+                for key in [k for k in self._datasets if k[0] == e.root]:
+                    del self._datasets[key]
+                for t in e.producers | e.consumers:
+                    self._remote_done.pop(t.stage_id, None)
+                    self._remote_failed.discard(t.stage_id)
                 self._finished -= e.producers | e.consumers
             self._update_gauges_locked()
             if not self._edges:
                 chunkstore.set_dag_hooks(None)
             self._cond.notify_all()
 
-    def stage_finished(self, token: StageToken) -> None:
+    def stage_finished(self, token: StageToken, ok: bool = True) -> None:
         """A stage reached a terminal state: release every exchange claim
         it still held and wake gate/stall waiters (producers-done and
-        consumers-alive conditions may both have flipped)."""
+        consumers-alive conditions may both have flipped). ``ok=False``
+        (failed/cancelled) matters cross-host: peers gating on this
+        stage's blocks must poison their downstream cone, not consume a
+        half-written edge."""
         with self._cond:
             self._finished.add(token)
             for key in list(self._pending):
@@ -376,6 +405,139 @@ class StreamRegistry:
                         self._exchange_bytes -= nbytes
             self._update_gauges_locked()
             self._cond.notify_all()
+            x = self._exchange
+        if x is not None:
+            x.broadcast_done(token.stage_id, ok)
+
+    # -- cross-host exchange (dag/exchange.py) ------------------------------
+
+    def set_exchange(self, x) -> None:
+        """Attach (or detach, with None) the cross-host exchange. The
+        remote-state maps live and die with the attachment — a later
+        single-process run must not see a stale peer's coverage."""
+        with self._cond:
+            self._exchange = x
+            if x is None:
+                self._remote_cov.clear()
+                self._remote_done.clear()
+                self._remote_failed.clear()
+                self._dead_ranks.clear()
+            self._cond.notify_all()
+
+    def remote_cover(self, root, path, positions, rank, per=1) -> None:
+        """A peer rank published these chunk positions (exchange server
+        thread). First writer wins the ownership slot: re-publishes of
+        an already-owned position keep the original fetch target."""
+        with self._cond:
+            owner = self._remote_cov.setdefault((root, path), {})
+            for p in positions:
+                owner.setdefault(tuple(p), int(rank))
+            self._cond.notify_all()
+
+    def remote_done(self, stage_id, rank, ok=True) -> None:
+        """A peer rank's instance of a stage reached a terminal state;
+        a failed/cancelled one additionally marks the stage remote-failed
+        so gates on its unpublished blocks raise instead of consuming a
+        half-written edge."""
+        with self._cond:
+            self._remote_done.setdefault(str(stage_id), set()).add(
+                int(rank))
+            if not ok:
+                self._remote_failed.add(str(stage_id))
+            self._cond.notify_all()
+
+    def remote_rank_dead(self, rank) -> None:
+        """A peer's connection dropped without a goodbye: its unpublished
+        blocks will never arrive. Gates waiting on them raise (failing
+        exactly the downstream cone) instead of hanging forever."""
+        with self._cond:
+            self._dead_ranks.add(int(rank))
+            self._cond.notify_all()
+
+    def wait_remote_done(self, stage_id, ranks) -> bool:
+        """Adopt the outcome of a rank-pinned stage this rank does not
+        own: block until every owner rank has broadcast ``done`` for
+        ``stage_id`` over the exchange. True when all owners finished
+        OK; False when the stage failed on a peer or an owner died
+        before reporting — the caller fails its local instance so the
+        downstream cone poisons identically on every rank."""
+        stage_id, want = str(stage_id), {int(r) for r in ranks}
+        with self._cond:
+            while True:
+                if stage_id in self._remote_failed:
+                    return False
+                have = set(self._remote_done.get(stage_id, ()))
+                if want <= have:
+                    return True
+                if (want - have) & self._dead_ranks:
+                    return False
+                self._cond.wait(_TICK_S)
+                _cancel.check("dag remote stage")
+
+    def serve_chunk(self, root, path, pos):
+        """Produce one locally-owned decoded chunk for a remote fetch:
+        the decoded-chunk LRU first, the container as fallback (the
+        producing write always lands there — for an elided edge, in THIS
+        rank's memory:// kvstore). None when this rank cannot serve it.
+        Runs on exchange server threads with no ambient stage, so the
+        re-entrant ``ds.read`` is neither gated nor accounted."""
+        with self._lock:
+            ds = self._datasets.get((root, path))
+        if ds is None:
+            return None
+        pos = tuple(int(x) for x in pos)
+        if chunkcache.enabled() and ds._cacheable():
+            arr = chunkcache.get_cache().get(
+                (ds._cache_key(), ds._cache_sig(), pos))
+            if arr is not None:
+                return np.asarray(arr)
+        geo = _geometry(ds)
+        if geo is None:
+            return None
+        block, dims = geo
+        nd = len(block)
+        if len(pos) != nd:
+            return None
+        lo = [pos[d] * block[d] for d in range(nd)]
+        if any(lo[d] < 0 or lo[d] >= dims[d] for d in range(nd)):
+            return None
+        shape = [min(block[d], dims[d] - lo[d]) for d in range(nd)]
+        return np.asarray(ds.read(lo, shape))
+
+    def _fetch_remote(self, edge, ds, root, path, need) -> None:
+        """Pull the remote-owned chunks a gated read needs into the local
+        decoded-chunk LRU, once, so the read below resolves via the cache
+        (zero container decode — crucial for elided roots, whose LOCAL
+        memory container never held a remote rank's bytes)."""
+        x = self._exchange
+        if x is None:
+            return
+        with self._lock:
+            rcov = self._remote_cov.get((root, path))
+            if not rcov:
+                return
+            cov = self._coverage.get((root, path)) or ()
+            todo = [(p, rcov[p]) for p in need
+                    if p in rcov and p not in cov]
+        if not todo:
+            return
+        if not (chunkcache.enabled() and ds._cacheable()):
+            # no local tier to land the bytes in: the read falls through
+            # to the container — correct on a shared filesystem, and the
+            # covers above still gated it for readiness
+            return
+        cc = chunkcache.get_cache()
+        dkey, sig = ds._cache_key(), ds._cache_sig()
+        fetched = 0
+        for pos, rank in todo:
+            if cc.get((dkey, sig, pos)) is not None:
+                continue   # already fetched (or handed off) — once only
+            arr = x.fetch(rank, root, path, pos)
+            cc.put((dkey, sig, pos), arr, record_miss=False)
+            fetched += int(arr.nbytes)
+        if fetched:
+            with self._lock:
+                edge.bytes_xhost += fetched
 
     def _update_gauges_locked(self) -> None:
         _EXCHANGE.set(self._exchange_bytes)
@@ -420,6 +582,7 @@ class StreamRegistry:
         edge, tok, root, path, block, _dims = res
         need = _touched_positions(offset, shape, block)
         self._wait_and_consume(edge, tok, root, path, need)
+        self._fetch_remote(edge, ds, root, path, need)
         ents = self._handoff.pop_many([(root, path, p) for p in need])
         if ents:
             self._spill(ents)
@@ -506,13 +669,44 @@ class StreamRegistry:
 
     def _missing_locked(self, root, path, need, edge, tok) -> bool:
         cov = self._coverage.get((root, path))
-        if cov is not None and all(p in cov for p in need):
+        rcov = self._remote_cov.get((root, path))
+        if all((cov is not None and p in cov)
+               or (rcov is not None and p in rcov) for p in need):
             return False
+        if self._dead_ranks:
+            # chunks are still missing and a peer died holding them:
+            # hanging here would wedge the stage forever — raise, so only
+            # this consumer's downstream cone fails
+            raise ExchangeError(
+                f"exchange peer rank(s) {sorted(self._dead_ranks)} died "
+                f"with blocks outstanding on edge {edge.name}")
+        bad = {p.stage_id for p in edge.producers
+               if p.stage_id in self._remote_failed}
+        if bad:
+            # a peer's instance of a producer failed: its slice of the
+            # edge will never publish — consuming now would read a
+            # half-written edge
+            raise ExchangeError(
+                f"producer stage(s) {sorted(bad)} failed on a peer rank "
+                f"with blocks outstanding on edge {edge.name}")
         # blocks a producer never writes (fusion's empty blocks) resolve
         # when every OTHER producer is terminal — the data then simply is
         # what the container holds
-        return not all(p in self._finished
-                       for p in edge.producers if p is not tok)
+        return not self._producers_done_locked(edge, tok)
+
+    def _producers_done_locked(self, edge, tok) -> bool:
+        for p in edge.producers:
+            if p is not tok and p not in self._finished:
+                return False
+        # cross-host: every peer rank's instance of each producer stage
+        # must be terminal too (its last covers have then been sent)
+        w = self._exchange.world if self._exchange is not None else 1
+        if w > 1:
+            for p in edge.producers:
+                peers = self._remote_done.get(p.stage_id, ())
+                if len(set(peers) | self._dead_ranks) < w - 1:
+                    return False
+        return True
 
     def _consume_locked(self, edge, tok, root, path, need) -> None:
         drained = False
@@ -580,12 +774,21 @@ class StreamRegistry:
             _trace.instant("dag.publish", stage=edge.name, nbytes=nbytes,
                            item=tuple(int(o) for o in offset))
         with self._cond:
-            self._publish_locked(edge, tok, root, path, covered, per)
+            self._datasets[(root, path)] = ds
+            fresh = self._publish_locked(edge, tok, root, path, covered,
+                                         per)
+        # broadcast OUTSIDE the lock: a full peer queue blocks (bounded
+        # backpressure), and gate waiters must keep draining meanwhile
+        x = self._exchange
+        if x is not None and fresh:
+            x.broadcast_cover(root, path, fresh, per)
+        with self._cond:
             self._stall_locked(edge, tok)
 
-    def _publish_locked(self, edge, tok, root, path, covered, per) -> None:
+    def _publish_locked(self, edge, tok, root, path, covered, per) -> list:
         """Shared completion accounting of the host and device publish
-        paths: coverage, per-run totals, the exchange ledger."""
+        paths: coverage, per-run totals, the exchange ledger. Returns the
+        first-time-covered positions (the cross-host cover broadcast)."""
         cov = self._coverage.setdefault((root, path), set())
         fresh = [p for p in covered if p not in cov]
         cov.update(covered)
@@ -601,6 +804,7 @@ class StreamRegistry:
                 self._exchange_bytes += per * len(fresh)
             self._update_gauges_locked()
         self._cond.notify_all()
+        return fresh
 
     def on_write_device(self, ds, dev, offset) -> bool:
         """Producer side, device tier: keep a finished block's covered
@@ -614,6 +818,11 @@ class StreamRegistry:
         through the container like any host write), as are datasets the
         spill tier could not hold coherently (non-cacheable stores)."""
         if not self._edges or not self._handoff.enabled():
+            return False
+        if self._exchange is not None:
+            # chunks held only in HBM are invisible to remote fetches
+            # (serve_chunk reads the host tiers): multi-process runs keep
+            # every publish on the host path
             return False
         tok = _current_stage.get()
         if tok is None:

@@ -483,9 +483,11 @@ class _DeviceRelax:
     first and every masked re-solve of the iterative drop-worst-link loop
     — re-enters the same compiled ``lax.while_loop`` with a per-link
     weight mask. Above ``BST_SOLVE_SHARD`` point rows the arrays are laid
-    out per local device (tiles placed cost-weighted via
-    ``pairsched.assign_tasks``) and each sweep's segment moments reduce
-    with ``lax.psum`` over the 1-D solve mesh axis."""
+    out per mesh device — every process's devices when the global solve
+    mesh is on (``BST_SOLVE_GLOBAL``), the local ones otherwise — with
+    tiles placed cost-weighted via ``pairsched.assign_tasks`` and each
+    sweep's segment moments reduced with ``lax.psum`` over the 1-D solve
+    mesh axis."""
 
     def __init__(self, links: list[MatchLink], tiles: list[Key],
                  fixed: set[Key], params: SolverParams):
@@ -502,8 +504,8 @@ class _DeviceRelax:
                  np.asarray(lk.p, np.float64), np.asarray(lk.q, np.float64),
                  np.asarray(lk.w, np.float64)) for lk in self.links]
         n_rows = 2 * sum(len(lk.p) for lk in self.links)
-        n_shards = _dsolve.shard_count(n_rows)
-        # bst-lint: off=host-sync (shard_count returns a host int)
+        n_shards, global_mesh = _dsolve.solve_layout(n_rows)
+        # bst-lint: off=host-sync (solve_layout returns host ints)
         if n_shards > 1:
             from ..parallel.pairsched import PairTask, assign_tasks
 
@@ -521,7 +523,8 @@ class _DeviceRelax:
                 for t in bin_tasks:
                     tile_shard[t.index] = d
             self.problem = _dsolve.prepare_relax(rows, T, n_shards,
-                                                 tile_shard)
+                                                 tile_shard,
+                                                 global_mesh=global_mesh)
         else:
             self.problem = _dsolve.prepare_relax(rows, T, 1)
         self.fixed_mask = np.zeros(T, bool)

@@ -334,7 +334,7 @@ _FFT_WORKSPACE_MULT = 4.0
 
 
 def stitch_jobs(sd, jobs: list[_PairJob], params: StitchingParams,
-                devices: int | None = None, multihost: bool = False
+                devices: int | None = None, multihost: bool | None = None
                 ) -> list[PairwiseStitchingResult]:
     """Run the device PCM + host refinement pipeline over prepared jobs.
 
@@ -347,12 +347,12 @@ def stitch_jobs(sd, jobs: list[_PairJob], params: StitchingParams,
     the device FFTs of the next. One local device degrades to exactly that
     pipelined loop on the caller's thread (the pre-sharding path).
 
-    ``multihost=True`` composes with ``parallel.distributed``: chunks
-    split across processes FIRST (strided ``partition_items``), each
-    process's slice over its local devices second — the returned list
-    then holds only THIS process's pairs (collecting the slices into one
-    XML is the caller's concern; default False keeps the reference's
-    driver-side-collect single-process contract)."""
+    In a multi-process world chunks split across processes FIRST
+    (cost-aware LPT over FFT volume), each process's slice over its
+    local devices second, and the per-process results allgather back so
+    every rank returns the full pair list — on by default when
+    ``jax.process_count() > 1`` (``BST_PAIR_MULTIHOST``); pass
+    ``multihost=False``/``True`` to pin it."""
     from ..parallel.pairsched import PairTask, run_pair_tasks
 
     buckets: dict[tuple, list[_PairJob]] = {}

@@ -67,6 +67,11 @@ METRICS: dict[str, str] = {
     "bst_pair_redispatch_total":
         "pair tasks re-dispatched after a device failure",
     "bst_pair_device_util_pct": "stage device-utilization percentage",
+    "bst_pair_proc_busy_ms_total":
+        "per-process pair-scheduler busy milliseconds (stage, process) — "
+        "the multihost split-imbalance evidence",
+    "bst_pair_proc_util_pct":
+        "per-process pair-scheduler device-utilization percentage",
     # timeline flight recorder (observe/trace.py)
     "bst_trace_events_total": "trace events recorded into the ring buffer",
     "bst_trace_events_dropped_total":
@@ -168,6 +173,20 @@ METRICS: dict[str, str] = {
     "bst_dag_containers_elided_total":
         "ephemeral intermediate containers elided to memory (never "
         "materialized on disk)",
+    # cross-host streamed edges (dag/exchange.py): rank-addressed block
+    # exchange that extends streamed-edge gating across process boundaries
+    "bst_dag_xhost_fetches_total":
+        "remote-owned chunks fetched over the cross-host block exchange",
+    "bst_dag_xhost_bytes_total":
+        "streamed-edge bytes fetched from peer ranks over TCP (each "
+        "remote-owned chunk fetched once into the local decoded LRU)",
+    "bst_dag_xhost_served_bytes_total":
+        "streamed-edge bytes this rank served to fetching peers",
+    "bst_dag_xhost_stall_seconds_total":
+        "seconds producers blocked on a peer's bounded exchange queue "
+        "(cross-host backpressure)",
+    "bst_dag_xhost_peers_connected":
+        "exchange peer connections currently established by this rank",
     # telemetry-loop closer (tune/): advisor rules + autotuner trials +
     # daemon-side profile application
     "bst_tune_trials_total":
@@ -244,6 +263,13 @@ SPANS: dict[str, str] = {
     "solve.reduce":
         "host fetch of a device solve's final models/errors (the single "
         "drain point of a solve call)",
+    "solve.global":
+        "a global-mesh solve kernel spanning every process's devices on "
+        "the links axis (psum-sharded relax or intensity CG)",
+    # multihost pair split (parallel/pairsched.py)
+    "pair.allgather":
+        "cross-process allgather merging each rank's pair-task results "
+        "after a processes-first split",
     # cross-host telemetry relay (observe/relay.py)
     "relay.send":
         "one relay message's serialization + socket send on the client's "
@@ -269,6 +295,10 @@ SPANS: dict[str, str] = {
         "handoff-cache chunks materialized to the host tier (eviction, "
         "host read, or flush)",
     "dag.cleanup": "ephemeral intermediate-container cleanup",
+    "dag.xhost_fetch":
+        "one remote-owned chunk fetched from a peer rank over TCP",
+    "dag.xhost_serve":
+        "this rank served one chunk to a fetching peer",
     # telemetry-loop closer (tune/)
     "tune.advise": "one advisor pass over a recorded run's evidence",
     "tune.trial": "one autotuner trial execution under candidate overrides",

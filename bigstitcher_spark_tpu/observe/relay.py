@@ -282,6 +282,14 @@ class RelayClient:
             payload["chunk_cache"] = get_cache().stats()
         except Exception:   # cache layer optional for bare clients
             pass
+        try:
+            from ..parallel.pairsched import process_util_snapshot
+
+            util = process_util_snapshot()
+            if util:
+                payload["pair_util"] = util
+        except Exception:   # scheduler layer optional for bare clients
+            pass
         return payload
 
     def _deliver(self, msg: dict) -> None:
@@ -647,6 +655,7 @@ class RelayCollector:
                 "progress": snap.get("progress"),
                 "process": snap.get("process"),
                 "chunk_cache": snap.get("chunk_cache"),
+                "pair_util": snap.get("pair_util"),
                 "inflight": snap.get("inflight"),
                 "trace": snap.get("trace"),
                 "dropped": snap.get("dropped"),

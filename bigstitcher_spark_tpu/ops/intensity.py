@@ -272,15 +272,16 @@ def _solve_coefficients_device(n_cells, rows, lam_eff, cell_xx, cell_n,
     else:
         sidx = np.zeros((0, 2), int)
         sw = np.zeros(0)
-    n_shards = _dsolve.shard_count(len(rows))
+    n_shards, global_mesh = _dsolve.solve_layout(len(rows))
     # build + XLA-compile outside the timed span (cold-bucket builds must
     # not pollute the device-ms counter); the bucket record derives from
     # the SAME shape math the factory key uses
-    _dsolve.ensure_cg_compiled(n_cells, len(rows), len(sidx), n_shards)
+    _dsolve.ensure_cg_compiled(n_cells, len(rows), len(sidx), n_shards,
+                               global_mesh)
     t0 = time.perf_counter()
     with profiling.span("solve.relax", stage="intensity", item=len(rows)):
         out = _dsolve.solve_intensity_device(
-            n_cells, rows, diag, rhs, sidx, sw, n_shards)
+            n_cells, rows, diag, rhs, sidx, sw, n_shards, global_mesh)
     _metrics.counter("bst_solve_device_ms_total", stage="intensity").inc(
         (time.perf_counter() - t0) * 1000.0)
     if on_device_solution is not None:

@@ -54,7 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import enable_x64
 from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import config
 from . import models as M
@@ -83,14 +83,65 @@ def resolve_backend(explicit: str | None = None) -> str:
     return "device" if config.get_bool("BST_SOLVE_DEVICE") else "numpy"
 
 
-def shard_count(n_rows: int) -> int:
-    """How many local devices a solve of ``n_rows`` rows shards over:
-    all of them above the ``BST_SOLVE_SHARD`` threshold (0 = never),
-    one otherwise. Shared by the relax and CG layouts so the threshold
-    semantics cannot diverge between them."""
+def global_enabled() -> bool:
+    """Whether the sharded solve mesh spans ALL processes' devices
+    (``BST_SOLVE_GLOBAL``): ``auto`` follows the jax world (>1 process),
+    ``1`` forces the global layout (single-process worlds then span just
+    the local devices — the 'virtual' global mesh the parity tests use),
+    ``0`` pins the mesh to local devices."""
+    mode = config.get_str("BST_SOLVE_GLOBAL") or "auto"
+    if mode == "1":
+        return True
+    if mode == "0":
+        return False
+    return jax.process_count() > 1
+
+
+def solve_layout(n_rows: int) -> tuple[int, bool]:
+    """``(n_shards, global_mesh)`` for a solve of ``n_rows`` point rows:
+    above the ``BST_SOLVE_SHARD`` threshold (0 = never) the links axis
+    spans every device of the execution world — ALL processes' devices
+    when :func:`global_enabled`, the local ones otherwise. Shared by the
+    relax and CG layouts so the threshold semantics cannot diverge
+    between them."""
     thr = config.get_int("BST_SOLVE_SHARD") or 0
-    n_dev = len(jax.local_devices())
-    return n_dev if (thr > 0 and n_rows >= thr and n_dev > 1) else 1
+    g = global_enabled()
+    n_dev = len(jax.devices()) if g else len(jax.local_devices())
+    if thr > 0 and n_rows >= thr and n_dev > 1:
+        return n_dev, g
+    return 1, False
+
+
+def shard_count(n_rows: int) -> int:
+    """Shard count of :func:`solve_layout` (compat wrapper)."""
+    return solve_layout(n_rows)[0]
+
+
+def _solve_mesh(n_shards: int, global_mesh: bool) -> Mesh:
+    """The 1-D solve mesh: the first ``n_shards`` devices of the world
+    (global) or the host (local) along the ``links`` axis."""
+    devs = (jax.devices() if global_mesh else jax.local_devices())[:n_shards]
+    return Mesh(np.array(devs), (SOLVE_AXIS,))
+
+
+def global_axis_span(n_shards: int, global_mesh: bool) -> tuple[int, int]:
+    """``(n_devices, n_processes)`` the solve mesh axis spans — the
+    introspection hook the MULTICHIP dryrun and the multihost tests use
+    to assert the global links axis really crosses process boundaries."""
+    devs = (jax.devices() if global_mesh else jax.local_devices())[:n_shards]
+    return len(devs), len({d.process_index for d in devs})
+
+
+def _to_global(mesh: Mesh, arr, spec) -> jax.Array:
+    """Lift a host array every process holds in full onto the (possibly
+    multi-process) solve mesh with the given PartitionSpec. The callback
+    slices the SAME replicated host array on every rank — the solver is
+    driver-side collect, so each process already has identical inputs —
+    which makes cross-host construction exact and allocation-local."""
+    a = np.asarray(arr)
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(a.shape, sharding,
+                                        lambda idx: a[idx])
 
 
 def _record_bucket(namespace: str, key: tuple) -> bool:
@@ -128,6 +179,7 @@ class RelaxProblem:
     w: np.ndarray             # row weights (0.0 on padding)
     link_id: np.ndarray       # link index per row
     side_a: np.ndarray        # 1.0 on the A-side copy of each match row
+    global_mesh: bool = False  # links axis spans all processes' devices
 
     @property
     def T_pad(self) -> int:
@@ -139,9 +191,10 @@ class RelaxProblem:
 
     def bucket_key(self, model: str, reg: str, hist_cap: int,
                    pw: int) -> tuple:
-        """The compile-bucket identity of this problem's kernel."""
+        """The compile-bucket identity of this problem's kernel (keyed by
+        the GLOBAL axis size — n_shards counts every mesh device)."""
         return (model, reg, self.T_pad, self.local.shape[-2], self.L_pad,
-                hist_cap, pw, self.n_shards)
+                hist_cap, pw, self.n_shards, self.global_mesh)
 
 
 def prepare_relax(
@@ -149,6 +202,7 @@ def prepare_relax(
     n_tiles: int,
     n_shards: int = 1,
     tile_shard: np.ndarray | None = None,
+    global_mesh: bool = False,
 ) -> RelaxProblem:
     """Flatten ``(ia, ib, p, q, w)`` links into padded device-ready arrays.
 
@@ -156,7 +210,9 @@ def prepare_relax(
     a shard (callers place tiles cost-weighted via
     ``pairsched.assign_tasks``); rows keep their single-device relative
     order within each shard so per-tile segment sums are bit-identical
-    across layouts."""
+    across layouts. ``global_mesh`` marks a layout whose shards span
+    every process's devices (the shard arrays are identical on every
+    rank; each rank materializes only its addressable slices)."""
     loc, tgt, own, other, w, lid, side = [], [], [], [], [], [], []
     for l, (ia, ib, p, q, wl) in enumerate(link_rows):
         n = len(p)
@@ -205,7 +261,8 @@ def prepare_relax(
     local, target, own_a, other_a, w_a, lid_a, side_a = (
         np.stack(s) for s in stacks)
     return RelaxProblem(n_tiles, len(link_rows), n_rows, n_shards, local,
-                        target, own_a, other_a, w_a, lid_a, side_a)
+                        target, own_a, other_a, w_a, lid_a, side_a,
+                        global_mesh=global_mesh)
 
 
 # ---------------------------------------------------------------------------
@@ -343,7 +400,8 @@ def _relax_core(model: str, reg: str, T_pad: int, L_pad: int, hist_cap: int,
 
 @functools.lru_cache(maxsize=32)
 def _build_relax_fn(model: str, reg: str, T_pad: int, N_pad: int,
-                    L_pad: int, hist_cap: int, pw: int, n_shards: int):
+                    L_pad: int, hist_cap: int, pw: int, n_shards: int,
+                    global_mesh: bool = False):
     """Compile (or fetch) the relax kernel for one shape bucket. Callers
     count warm/cold via ``record_compile_bucket`` at the call site."""
     if n_shards <= 1:
@@ -351,8 +409,7 @@ def _build_relax_fn(model: str, reg: str, T_pad: int, N_pad: int,
                              lambda t: t)
         return jax.jit(kernel)
 
-    devs = jax.local_devices()[:n_shards]
-    mesh = Mesh(np.array(devs), (SOLVE_AXIS,))
+    mesh = _solve_mesh(n_shards, global_mesh)
     psum = functools.partial(jax.lax.psum, axis_name=SOLVE_AXIS)
     kernel = _relax_core(model, reg, T_pad, L_pad, hist_cap, pw,
                          lambda t: jax.tree_util.tree_map(psum, t))
@@ -434,12 +491,30 @@ def relax_on_device(
     with enable_x64():
         fn = _build_relax_fn(model, reg, T_pad, problem.local.shape[-2],
                              L_pad, hist_cap, plateau_width,
-                             problem.n_shards)
-        out = fn(problem.local, problem.target, problem.own, problem.other,
-                 problem.w, problem.link_id, problem.side_a, lw, fm, wt,
-                 jnp.float64(lam), jnp.float64(damping),
-                 jnp.float64(max_error), jnp.int32(run_iter))
-        jax.block_until_ready(out)
+                             problem.n_shards, problem.global_mesh)
+        args = (problem.local, problem.target, problem.own, problem.other,
+                problem.w, problem.link_id, problem.side_a, lw, fm, wt,
+                np.float64(lam), np.float64(damping),
+                np.float64(max_error), np.int32(run_iter))
+        if problem.global_mesh:
+            # multi-process mesh: every input must be a global jax.Array
+            # with the kernel's exact sharding (each rank materializes
+            # only its addressable slices of the replicated host arrays)
+            mesh = _solve_mesh(problem.n_shards, True)
+            specs = (P(SOLVE_AXIS),) * 7 + (P(),) * 7
+            args = tuple(_to_global(mesh, a, s)
+                         for a, s in zip(args, specs))
+            from .. import profiling
+
+            n_dev, n_proc = global_axis_span(problem.n_shards, True)
+            with profiling.span("solve.global", stage="relax",
+                                item=f"{n_dev}dev/{n_proc}proc"):
+                out = fn(*args)
+                jax.block_until_ready(out)
+        else:
+            out = fn(*args[:10], jnp.float64(lam), jnp.float64(damping),
+                     jnp.float64(max_error), jnp.int32(run_iter))
+            jax.block_until_ready(out)
     return out
 
 
@@ -450,7 +525,7 @@ def relax_on_device(
 
 @functools.lru_cache(maxsize=16)
 def _build_cg_fn(n_unknowns: int, M_pad: int, S_pad: int, max_iter: int,
-                 n_shards: int):
+                 n_shards: int, global_mesh: bool = False):
     """CG over the intensity quadratic form. The data term is applied
     per match row (gather the four unknowns, apply the 4x4 block, scatter
     the residual forces) and psum-reduced when sharded; the smoothness +
@@ -511,8 +586,7 @@ def _build_cg_fn(n_unknowns: int, M_pad: int, S_pad: int, max_iter: int,
 
         return jax.jit(single)
 
-    devs = jax.local_devices()[:n_shards]
-    mesh = Mesh(np.array(devs), (SOLVE_AXIS,))
+    mesh = _solve_mesh(n_shards, global_mesh)
 
     def shard_fn(ca, cb, mn, sx, sy, sxx, syy, sxy, si, sj, sweights,
                  diag, rhs, x0, tol2, max_iter_run):
@@ -547,17 +621,18 @@ def _cg_shapes(n_cells: int, n_rows: int, n_smooth: int,
 
 
 def ensure_cg_compiled(n_cells: int, n_rows: int, n_smooth: int,
-                       n_shards: int) -> bool:
+                       n_shards: int, global_mesh: bool = False) -> bool:
     """Build + XLA-compile the CG kernel for this shape bucket outside
     any timed span (cold buckets run one zero-iteration solve), and
     warm/cold-count the request. Returns the warm flag."""
     shapes = _cg_shapes(n_cells, n_rows, n_smooth, n_shards)
-    warm = _record_bucket("solve_cg", shapes + (n_shards,))
+    warm = _record_bucket("solve_cg", shapes + (n_shards, global_mesh))
     if not warm:
         solve_intensity_device(
             n_cells, np.zeros((n_rows, 8)), np.ones(2 * n_cells),
             np.zeros(2 * n_cells), np.zeros((n_smooth, 2), int),
-            np.zeros(n_smooth), n_shards, limit_iterations=0)
+            np.zeros(n_smooth), n_shards, global_mesh=global_mesh,
+            limit_iterations=0)
     return warm
 
 
@@ -569,6 +644,7 @@ def solve_intensity_device(
     smooth_idx: np.ndarray,
     smooth_weights: np.ndarray,
     n_shards: int = 1,
+    global_mesh: bool = False,
     rtol: float = 1e-11,
     limit_iterations: int | None = None,
 ) -> tuple[np.ndarray, int]:
@@ -623,9 +699,25 @@ def solve_intensity_device(
     else:
         max_iter_run = max_iter
     with enable_x64():
-        fn = _build_cg_fn(n_unknowns, M_pad, S_pad, max_iter, n_shards)
-        out = fn(ca, cb, *stats, spad[:, 0], spad[:, 1], wpad, dpad,
-                 rhspad, x0, jnp.float64(tol2),
-                 jnp.int32(max_iter_run))
-        jax.block_until_ready(out)
+        fn = _build_cg_fn(n_unknowns, M_pad, S_pad, max_iter, n_shards,
+                          global_mesh)
+        args = (ca, cb, *stats, spad[:, 0], spad[:, 1], wpad, dpad,
+                rhspad, x0, np.float64(tol2), np.int32(max_iter_run))
+        if global_mesh and n_shards > 1:
+            mesh = _solve_mesh(n_shards, True)
+            specs = (P(SOLVE_AXIS),) * 8 + (P(),) * 8
+            args = tuple(_to_global(mesh, a, s)
+                         for a, s in zip(args, specs))
+            from .. import profiling
+
+            n_dev, n_proc = global_axis_span(n_shards, True)
+            with profiling.span("solve.global", stage="intensity",
+                                item=f"{n_dev}dev/{n_proc}proc"):
+                out = fn(*args)
+                jax.block_until_ready(out)
+        else:
+            out = fn(ca, cb, *stats, spad[:, 0], spad[:, 1], wpad, dpad,
+                     rhspad, x0, jnp.float64(tol2),
+                     jnp.int32(max_iter_run))
+            jax.block_until_ready(out)
     return out

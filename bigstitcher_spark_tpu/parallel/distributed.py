@@ -22,8 +22,12 @@ Launch recipe (two hosts):
 ``jax.distributed.initialize()`` autodetects the topology there).
 
 Stages that COLLECT results to the project XML (detection, matching,
-stitching, solver) follow the reference's driver-side-collect design and
-should run single-process; the block-writing stages are where the volume is.
+stitching, solver) historically ran single-process; with the global
+execution mesh they join the scale-out too: the pair-parallel stages
+split across processes and :func:`allgather_object` merges the results so
+every rank still holds the full list (parallel/pairsched.py), and the
+sharded device solves span every process's devices over one global
+"links" mesh axis (ops/solve.py, BST_SOLVE_GLOBAL).
 """
 
 from __future__ import annotations
@@ -72,10 +76,12 @@ def init_distributed(
         if coordinator_address is None and num_processes is None:
             if config.get_bool("BST_DISTRIBUTED"):
                 # Cloud TPU pod / SLURM: topology autodetected by jax
+                _enable_cpu_collectives(jax)
                 jax.distributed.initialize()
                 _initialized[0] = True
                 return True
             return False
+        _enable_cpu_collectives(jax)
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
@@ -86,6 +92,18 @@ def init_distributed(
     finally:
         if start_relay:
             _relay_bringup()
+
+
+def _enable_cpu_collectives(jax_mod) -> None:
+    """Select the gloo cross-process collectives for the CPU backend
+    BEFORE it initializes — without it a multi-process CPU world raises
+    "Multiprocess computations aren't implemented on the CPU backend" at
+    the first psum. Harmless on accelerator platforms (the flag only
+    affects XLA:CPU) and on jax builds without the option."""
+    try:
+        jax_mod.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
 
 
 def _relay_bringup() -> None:
@@ -157,3 +175,82 @@ def partition_items(
         raise ValueError(
             f"process_index {process_index} outside world size {process_count}")
     return list(items[process_index::process_count])
+
+
+def partition_indices_weighted(
+    costs: Sequence[float],
+    process_index: int | None = None,
+    process_count: int | None = None,
+) -> list[int]:
+    """Cost-aware process partition: LPT over the whole world, same
+    greedy as pairsched's device placement (heaviest first into the
+    least-loaded bin, ties by index / lowest bin) so a heavy-tailed pair
+    list doesn't straggle one process the way strided round-robin can.
+    Deterministic: every process computes the SAME assignment from the
+    same costs. Returns THIS process's item indices in ascending
+    (original) order; degenerates to range(len) at world size 1."""
+    if process_index is None or process_count is None:
+        pi, pc = world()
+        process_index = pi if process_index is None else process_index
+        process_count = pc if process_count is None else process_count
+    n = len(costs)
+    if process_count <= 1:
+        return list(range(n))
+    if not (0 <= process_index < process_count):
+        raise ValueError(
+            f"process_index {process_index} outside world size {process_count}")
+    order = sorted(range(n), key=lambda i: (-max(float(costs[i]), 0.0), i))
+    loads = [0.0] * process_count
+    mine: list[int] = []
+    for i in order:
+        b = loads.index(min(loads))
+        loads[b] += max(float(costs[i]), 1e-9)
+        if b == process_index:
+            mine.append(i)
+    mine.sort()
+    return mine
+
+
+def partition_items_weighted(
+    items: Sequence,
+    costs: Sequence[float],
+    process_index: int | None = None,
+    process_count: int | None = None,
+) -> list:
+    """:func:`partition_items` with LPT cost balancing: this process's
+    slice of ``items`` (original relative order preserved), where slices
+    are chosen so per-process total cost is near-equal. ``costs`` must
+    align with ``items``; cost-free callers should keep the round-robin
+    :func:`partition_items`."""
+    if len(items) != len(costs):
+        raise ValueError(
+            f"items/costs length mismatch: {len(items)} != {len(costs)}")
+    idx = partition_indices_weighted(costs, process_index, process_count)
+    return [items[i] for i in idx]
+
+
+def allgather_object(obj):
+    """Gather one picklable object per process; every rank returns the
+    rank-ordered list ``[obj_0, ..., obj_{pc-1}]``. This is the merge
+    primitive behind the multihost pair split (each process computes its
+    slice, everyone ends with the full result list — the SPMD analogue
+    of Spark's driver-side collect). World size 1 returns ``[obj]``
+    without touching the runtime. Collective: every process must call it
+    the same number of times, in the same order."""
+    pi, pc = world()
+    if pc <= 1:
+        return [obj]
+    import pickle
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    blob = np.frombuffer(
+        pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL), dtype=np.uint8)
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.array([blob.size], dtype=np.int64))).reshape(pc)
+    buf = np.zeros(int(sizes.max()), dtype=np.uint8)
+    buf[:blob.size] = blob
+    rows = np.asarray(multihost_utils.process_allgather(buf))
+    return [pickle.loads(rows[i, :int(sizes[i])].tobytes())
+            for i in range(pc)]

@@ -68,6 +68,23 @@ def narrow_dtype_savings(arrays) -> int:
                and a.dtype.kind in "iu" and a.dtype.itemsize < 4)
 
 
+def _commit_host_args(fn, shardings):
+    """Multi-process runtimes refuse host numpy args to a jit with
+    non-replicated shardings (JAX cannot tell host-local data from
+    global); commit them onto their shardings explicitly first — all
+    devices here are local, so the device_put is an ordinary H2D.
+    Single-process dispatch passes through untouched."""
+    def dispatch(*args, **kwargs):
+        if jax.process_count() > 1:
+            args = tuple(
+                jax.device_put(a, s)
+                if not isinstance(a, jax.Array)
+                and not s.is_fully_replicated else a
+                for a, s in zip(args, shardings))
+        return fn(*args, **kwargs)
+    return dispatch
+
+
 @functools.lru_cache(maxsize=8)
 def _cached_mesh(n_devices: int | None) -> Mesh:
     # LOCAL devices only: under jax.distributed each process works an
@@ -229,11 +246,12 @@ def _build_sharded_fuser(
 
     shard = NamedSharding(mesh, P(BLOCK_AXIS))
     repl = NamedSharding(mesh, P())
-    return jax.jit(
+    in_shardings = (repl, repl) + (shard,) * n_in
+    return _commit_host_args(jax.jit(
         batched,
-        in_shardings=(repl, repl) + (shard,) * n_in,
+        in_shardings=in_shardings,
         out_shardings=(shard,) * (2 + len(pyramid)),
-    )
+    ), in_shardings)
 
 
 def pad_batch(arrays: Sequence[np.ndarray], batch: int) -> list[np.ndarray]:
@@ -617,9 +635,10 @@ def shard_jit(fn, mesh: Mesh, n_in: int, n_repl: int = 0, n_out=None,
     shard = NamedSharding(mesh, P(BLOCK_AXIS))
     repl = NamedSharding(mesh, P())
     out_shardings = shard if n_out is None else (shard,) * n_out
-    return jax.jit(
+    in_shardings = (repl,) * n_repl + (shard,) * n_in
+    return _commit_host_args(jax.jit(
         fn,
-        in_shardings=(repl,) * n_repl + (shard,) * n_in,
+        in_shardings=in_shardings,
         out_shardings=out_shardings,
         static_argnames=static_argnames,
-    )
+    ), in_shardings)

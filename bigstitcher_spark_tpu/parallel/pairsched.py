@@ -170,7 +170,45 @@ class _StageMeters:
     def finish(self, wall_s: float) -> None:
         n = len(self._busy_s)
         if n and wall_s > 0:
-            self.util.set(round(100.0 * sum(self._busy_s) / (n * wall_s), 1))
+            busy = sum(self._busy_s)
+            self.util.set(round(100.0 * busy / (n * wall_s), 1))
+            _record_process_util(self.stage, busy, wall_s, n)
+
+
+# last-run per-stage busy/util of THIS process's pair scheduler, keyed by
+# stage — the relay snapshot payload behind `bst top --cluster`'s PAIR
+# column and the bench multihost extra's per-process io numbers
+_PROC_UTIL: dict[str, dict] = {}
+_PROC_UTIL_LOCK = threading.Lock()
+
+
+def _record_process_util(stage: str, busy_s: float, wall_s: float,
+                         n_dev: int) -> None:
+    try:
+        from .distributed import world
+
+        pi, pc = world()
+    except Exception:  # pragma: no cover - backend not initialized
+        pi, pc = 0, 1
+    util = round(100.0 * busy_s / (n_dev * wall_s), 1) if wall_s > 0 else 0.0
+    _metrics.counter("bst_pair_proc_busy_ms_total", stage=stage,
+                     process=str(pi)).inc(busy_s * 1000.0)
+    _metrics.gauge("bst_pair_proc_util_pct", stage=stage,
+                   process=str(pi)).set(util)
+    with _PROC_UTIL_LOCK:
+        _PROC_UTIL[stage] = {
+            "process": pi, "world": pc, "n_dev": n_dev,
+            "busy_s": round(busy_s, 3), "wall_s": round(wall_s, 3),
+            "util_pct": util,
+        }
+
+
+def process_util_snapshot() -> dict:
+    """Per-stage {busy_s, wall_s, util_pct, ...} of this process's last
+    pair-scheduler runs — merged into the telemetry relay snapshot so the
+    collector can show cross-process imbalance live."""
+    with _PROC_UTIL_LOCK:
+        return {k: dict(v) for k, v in _PROC_UTIL.items()}
 
 
 def _run_queue(queue, di, dispatch, drain, window, results, failures,
@@ -266,6 +304,62 @@ def _run_queue(queue, di, dispatch, drain, window, results, failures,
         flush(seg)
 
 
+def multihost_active(explicit: bool | None = None) -> bool:
+    """Whether the pair stages split their task lists across the
+    processes of the execution world before local device placement. An
+    explicit ``multihost=`` argument wins; the ``BST_PAIR_MULTIHOST``
+    knob (default ``auto``) otherwise turns the split ON exactly when
+    the jax world has more than one process. A single-process world
+    never splits — there is nothing to split."""
+    try:
+        from .distributed import world
+
+        pc = world()[1]
+    except Exception:  # pragma: no cover - backend not initializable
+        pc = 1
+    if pc <= 1:
+        return False
+    if explicit is not None:
+        return bool(explicit)
+    return (config.get_str("BST_PAIR_MULTIHOST") or "auto") != "0"
+
+
+def _merge_multihost(stage: str, results: list,
+                     err: BaseException | None, pi: int, pc: int) -> list:
+    """Exchange per-process pair results so every rank returns the FULL
+    task-index-ordered list (the SPMD analogue of the reference's
+    driver-side collect). A failing rank reports its error INTO the
+    gather, so healthy peers raise a ``RetryError`` naming it instead of
+    deadlocking on a collective that will never complete."""
+    from .distributed import allgather_object
+
+    if err is not None:
+        payload = ("err", f"{type(err).__name__}: {err}")
+    else:
+        payload = ("ok", {i: r[1] for i, r in enumerate(results)
+                          if r is not None})
+    # the gather doubles as the stage barrier: time spent here is the
+    # straggler signal of an imbalanced split
+    with _trace.span("pair.allgather", stage=stage):
+        gathered = allgather_object(payload)
+    if err is not None:
+        raise err
+    bad = [f"rank {r}: {p[1]}" for r, p in enumerate(gathered)
+           if p[0] == "err"]
+    if bad:
+        raise RetryError(
+            f"{stage}: multihost pair split failed on peer process(es) — "
+            f"{'; '.join(bad[:3])}")
+    merged = list(results)
+    for r, (_, vals) in enumerate(gathered):
+        if r == pi:
+            continue
+        for i, v in vals.items():
+            if merged[i] is None:
+                merged[i] = (True, v)
+    return merged
+
+
 def run_pair_tasks(
     tasks: Sequence[PairTask],
     dispatch: Callable[[PairTask], Any],
@@ -275,9 +369,9 @@ def run_pair_tasks(
     n_devices: int | None = None,
     stage: str = "pairs",
     budget_bytes: int | None = None,
-    multihost: bool = False,
+    multihost: bool | None = None,
 ) -> list:
-    """Run pair tasks across the local device mesh; results in task-index
+    """Run pair tasks across the execution world; results in task-index
     order.
 
     ``dispatch(task)`` runs under the task's assigned device
@@ -296,28 +390,64 @@ def run_pair_tasks(
     on the other devices (round-robin) before the stage raises
     ``RetryError``.
 
-    ``multihost=True`` composes with ``parallel.distributed``: pairs split
-    across PROCESSES first (the deterministic strided slice of
-    ``partition_items``) and this process's local devices second. The
-    returned list is still full-length in task order, with ``None`` at
-    every index another process owns — collecting/merging the per-process
-    slices (these stages are driver-side collects in the reference) stays
-    the caller's concern."""
+    In a multi-process world the task list splits across PROCESSES first
+    (cost-aware LPT via ``distributed.partition_indices_weighted``) and
+    this process's local devices second; after the local slice completes,
+    the per-process results allgather back so EVERY rank returns the full
+    list — callers keep the single-process contract unchanged. This is
+    the default whenever ``jax.process_count() > 1``
+    (:func:`multihost_active`, knob ``BST_PAIR_MULTIHOST``); pass
+    ``multihost=False`` to pin a call to every-rank-computes-everything,
+    or ``True`` to split even when the knob says 0."""
     tasks = list(tasks)
-    remote_idx: set[int] = set()
-    if multihost:
-        from .distributed import partition_items
+    n_slots = max((t.index for t in tasks), default=-1) + 1
+    covered = {t.index for t in tasks}
+    if multihost_active(multihost):
+        from .distributed import partition_indices_weighted, world
 
-        local = partition_items(tasks)
-        local_idx = {t.index for t in local}
-        remote_idx = {t.index for t in tasks if t.index not in local_idx}
-        tasks = local
+        pi, pc = world()
+        mine = set(partition_indices_weighted(
+            [max(t.cost, 0.0) for t in tasks], pi, pc))
+        local = [t for k, t in enumerate(tasks) if k in mine]
+        events.emit("pair.multihost", stage=stage, process=pi, world=pc,
+                    local=len(local), total=len(tasks))
+        err: BaseException | None = None
+        results: list = [None] * n_slots
+        try:
+            results = _run_local(local, dispatch, drain, devices,
+                                 n_devices, stage, budget_bytes, n_slots)
+        except BaseException as e:  # noqa: BLE001 - reported into gather
+            err = e
+        results = _merge_multihost(stage, results, err, pi, pc)
+    else:
+        results = _run_local(tasks, dispatch, drain, devices, n_devices,
+                             stage, budget_bytes, n_slots)
+    missing = [i for i, r in enumerate(results)
+               if r is None and i in covered]
+    if missing:
+        raise RetryError(
+            f"{stage}: {len(missing)} pair task(s) produced no result "
+            f"(indices {missing[:8]}...)")
+    return [None if r is None else r[1] for r in results]
+
+
+def _run_local(
+    tasks: list[PairTask],
+    dispatch: Callable[[PairTask], Any],
+    drain,
+    devices,
+    n_devices: int | None,
+    stage: str,
+    budget_bytes: int | None,
+    n_slots: int,
+) -> list:
+    """This process's share of a pair run over its local devices; returns
+    the raw slot list (``(True, value)`` at completed indices, ``None``
+    elsewhere) for :func:`run_pair_tasks` to merge/unwrap."""
     if not tasks:
-        return [None] * (max(remote_idx) + 1) if remote_idx else []
+        return [None] * n_slots
     devs = pair_devices(n_devices, devices)
     n_dev = len(devs)
-    n_slots = max(max(t.index for t in tasks) + 1,
-                  (max(remote_idx) + 1) if remote_idx else 0)
     results: list = [None] * n_slots
     failures: list[tuple[PairTask, int, Exception]] = []
     meters = _StageMeters(stage, n_dev)
@@ -419,10 +549,4 @@ def run_pair_tasks(
 
     meters.finish(time.perf_counter() - t_start)
     hb.finish()
-    missing = [i for i, r in enumerate(results)
-               if r is None and i not in remote_idx]
-    if missing:
-        raise RetryError(
-            f"{stage}: {len(missing)} pair task(s) produced no result "
-            f"(indices {missing[:8]}...)")
-    return [None if r is None else r[1] for r in results]
+    return results

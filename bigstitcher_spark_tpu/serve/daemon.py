@@ -164,6 +164,7 @@ class Daemon:
         # aggregated live plane for free)
         self._relay_arg = relay
         self._own_relay = False
+        self._own_exchange = False
         self.queue = JobQueue(self.slots)
         self.started_at = time.time()
         self._sock: socket.socket | None = None
@@ -206,6 +207,7 @@ class Daemon:
             self._router = _StdoutRouter()   # installs itself per job
         self._start_exporter()
         self._start_relay()
+        self._start_exchange()
         for slot in range(self.slots):
             th = ctx_thread(self._slot_loop, (slot,),
                             name=f"bst-serve-slot-{slot}")
@@ -271,6 +273,27 @@ class Daemon:
         self._own_relay = True
         observe.log(f"bst serve: telemetry relay collecting on "
                     f"{col.host}:{col.port}", stage="serve")
+
+    def _start_exchange(self) -> None:
+        """Host this rank's cross-host block-exchange endpoint
+        (BST_DAG_EXCHANGE_ADDR) so multi-process pipeline jobs submitted
+        to the daemon stream blocks between ranks; inert without the
+        knob or in a single-process world, and a bind failure downgrades
+        (the pipeline job will then reject multi-process specs loudly)."""
+        from ..dag import exchange as _exchange
+
+        try:
+            x = _exchange.ensure_started()
+        except Exception as e:   # noqa: BLE001 — never block the daemon
+            observe.log(f"bst serve: block exchange disabled ({e})",
+                        stage="serve")
+            return
+        if x is not None:
+            self._own_exchange = True
+            host, port = x.addresses[x.rank]
+            observe.log(f"bst serve: block exchange rank {x.rank}/"
+                        f"{x.world} serving on {host}:{port}",
+                        stage="serve")
 
     def _warm_mesh(self) -> None:
         """Pay jax init + device placement ONCE, before accepting work;
@@ -339,6 +362,10 @@ class Daemon:
 
             _relay.stop_collector()   # frees the address, clears the
             #                           cluster providers it attached
+        if self._own_exchange:
+            from ..dag import exchange as _exchange
+
+            _exchange.shutdown()   # frees the rank's exchange port
         if self._own_exporter:
             httpexport.stop()   # frees the port for the next daemon
         if self._own_trace and _trace.enabled():

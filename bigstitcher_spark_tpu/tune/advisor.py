@@ -293,6 +293,83 @@ def _rule_dag_handoff_miss(rec, flat, trace_rep, wall):
     return None
 
 
+_PAIR_SPREAD = 0.25          # per-process busy spread that means imbalance
+_MIN_PAIR_BUSY_MS = 500.0    # total pair busy before split advice fires
+
+
+def _by_label(flat: dict[str, float], base: str,
+              label: str) -> dict[str, float]:
+    """Sum a metric per value of one label (keys look like
+    ``name{process="0",stage="match"}``)."""
+    out: dict[str, float] = {}
+    for k, v in flat.items():
+        name, _, rest = k.partition("{")
+        if name != base or not rest:
+            continue
+        for part in rest.rstrip("}").split(","):
+            lk, _, lv = part.partition("=")
+            if lk.strip() == label:
+                lv = lv.strip().strip('"')
+                out[lv] = out.get(lv, 0.0) + v
+    return out
+
+
+def _rule_multihost_pair_imbalance(rec, flat, trace_rep, wall):
+    """Processes-first pair split where one rank's devices stayed busy
+    far longer than another's: the round-robin (count-balanced) split
+    handed one process the expensive pairs. No single knob fixes a skew
+    in the work itself — the remedy is the cost-weighted split
+    (``partition_items_weighted``) with real per-pair costs, so this
+    fires knob-less like the cold-bucket rule."""
+    busy = _by_label(flat, "bst_pair_proc_busy_ms_total", "process")
+    if len(busy) < 2:
+        return None
+    total = sum(busy.values())
+    if total < _MIN_PAIR_BUSY_MS:
+        return None
+    hi, lo = max(busy.values()), min(busy.values())
+    spread = (hi - lo) / hi if hi > 0 else 0.0
+    if spread < _PAIR_SPREAD:
+        return None
+    hot = max(busy, key=busy.get)
+    cold = min(busy, key=busy.get)
+    return Diagnosis(
+        rule="multihost_pair_imbalance",
+        detail=(f"multihost pair split is {spread:.0%} imbalanced: "
+                f"process {hot} stayed busy {hi:.0f}ms vs {lo:.0f}ms on "
+                f"process {cold} — the count-balanced split handed one "
+                f"rank the expensive pairs; pass per-pair costs "
+                f"(overlap voxels) through the cost-weighted LPT split "
+                f"so ranks finish together"),
+        confidence=round(min(0.9, 0.4 + spread / 2), 2),
+        evidence={"busy_ms_by_process":
+                  {k: round(v, 1) for k, v in sorted(busy.items())},
+                  "spread": round(spread, 3)})
+
+
+def _rule_xhost_backpressure(rec, flat, trace_rep, wall):
+    stall = _sum(flat, "bst_dag_xhost_stall_seconds_total")
+    if stall < max(1.0, _STALL_FRACTION * (wall or 0.0)):
+        return None
+    fetched = _sum(flat, "bst_dag_xhost_bytes_total")
+    cur = config.get_bytes("BST_DAG_EXCHANGE_BYTES")
+    return Diagnosis(
+        rule="xhost_exchange_backpressure",
+        detail=(f"producers stalled {stall:.1f}s on peers' bounded "
+                f"cross-host exchange queues"
+                + (f" ({stall / wall:.0%} of the {wall:.1f}s wall clock)"
+                   if wall else "")
+                + " — a larger exchange ledger lets ranks run further "
+                "ahead of their slowest consumer"),
+        confidence=round(min(0.9, 0.4 + (stall / wall if wall else 0.2)),
+                         2),
+        knob="BST_DAG_EXCHANGE_BYTES",
+        suggested_value=str(_clamped_double("BST_DAG_EXCHANGE_BYTES", cur)),
+        evidence={"stall_seconds": round(stall, 2),
+                  "xhost_bytes": int(fetched),
+                  "wall_seconds": round(wall or 0.0, 2)})
+
+
 def _rule_relay_drops(rec, flat, trace_rep, wall):
     drops = _sum(flat, "bst_relay_dropped_total")
     sent = _sum(flat, "bst_relay_sent_total")
@@ -315,6 +392,7 @@ def _rule_relay_drops(rec, flat, trace_rep, wall):
 _RULES = (_rule_low_overlap, _rule_cold_buckets, _rule_chunk_cache,
           _rule_tile_cache, _rule_inflight_saturated,
           _rule_dag_backpressure, _rule_dag_handoff_miss,
+          _rule_multihost_pair_imbalance, _rule_xhost_backpressure,
           _rule_relay_drops)
 
 

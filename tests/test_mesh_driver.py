@@ -337,18 +337,28 @@ class TestPairScheduler:
         assert single_fails["n"] == 1
 
     def test_multihost_partitions_pairs_processes_first(self, monkeypatch):
-        # pairs split across PROCESSES first (strided partition_items),
-        # local devices second; non-local slots come back as None
+        # pairs split across PROCESSES first (cost-aware LPT), local
+        # devices second; the allgather merge hands every rank the FULL
+        # result list (simulate rank 1 by answering the gather with the
+        # complementary slice's results)
         from bigstitcher_spark_tpu.parallel import distributed
         from bigstitcher_spark_tpu.parallel.pairsched import (
             PairTask, run_pair_tasks,
         )
 
-        monkeypatch.setattr(distributed, "world", lambda: (1, 2))
+        monkeypatch.setattr(distributed, "world", lambda: (0, 2))
+        other = set(distributed.partition_indices_weighted(
+            [1.0] * 7, 1, 2))
+
+        def fake_gather(payload):
+            assert payload[0] == "ok"
+            return [payload, ("ok", {i: i * 10 for i in other})]
+
+        monkeypatch.setattr(distributed, "allgather_object", fake_gather)
         out = run_pair_tasks(
             [PairTask(index=i, cost=1.0) for i in range(7)],
             lambda t: t.index * 10, stage="sched-mh-test", multihost=True)
-        assert out == [None, 10, None, 30, None, 50, None]
+        assert out == [i * 10 for i in range(7)]
 
     def test_poisoned_device_redispatches(self):
         # a device whose every call fails must degrade capacity, not kill

@@ -146,6 +146,54 @@ class TestAdvisorRules:
             "bst_dag_producer_stall_seconds_total": 0.3})
         assert tune.advise_record(rec) == []
 
+    def test_multihost_pair_imbalance(self):
+        rec = _healthy_record(metrics={
+            'bst_pair_proc_busy_ms_total{process="0",stage="match"}':
+                4000.0,
+            'bst_pair_proc_busy_ms_total{process="1",stage="match"}':
+                1000.0})
+        diags = tune.advise_record(rec)
+        assert [d.rule for d in diags] == ["multihost_pair_imbalance"]
+        d = diags[0]
+        # no single knob rebalances skewed work — the advice is the
+        # cost-weighted split
+        assert d.knob is None
+        assert "cost-weighted" in d.detail
+        assert d.evidence["busy_ms_by_process"] == {"0": 4000.0,
+                                                    "1": 1000.0}
+        assert d.evidence["spread"] == 0.75
+
+    def test_balanced_pair_split_is_quiet(self):
+        rec = _healthy_record(metrics={
+            'bst_pair_proc_busy_ms_total{process="0",stage="match"}':
+                2000.0,
+            'bst_pair_proc_busy_ms_total{process="1",stage="match"}':
+                1800.0})
+        assert tune.advise_record(rec) == []
+
+    def test_single_process_pair_busy_is_quiet(self):
+        # one rank's busy time alone says nothing about a split
+        rec = _healthy_record(metrics={
+            'bst_pair_proc_busy_ms_total{process="0",stage="match"}':
+                9000.0})
+        assert tune.advise_record(rec) == []
+
+    def test_xhost_backpressure(self):
+        rec = _healthy_record(metrics={
+            "bst_dag_xhost_stall_seconds_total": 2.5,
+            "bst_dag_xhost_bytes_total": 1 << 20})
+        diags = tune.advise_record(rec)
+        assert [d.rule for d in diags] == ["xhost_exchange_backpressure"]
+        d = diags[0]
+        assert d.knob == "BST_DAG_EXCHANGE_BYTES"
+        assert d.evidence["xhost_bytes"] == 1 << 20
+
+    def test_small_xhost_stall_is_quiet(self):
+        rec = _healthy_record(metrics={
+            "bst_dag_xhost_stall_seconds_total": 0.2,
+            "bst_dag_xhost_bytes_total": 1 << 20})
+        assert tune.advise_record(rec) == []
+
     def test_relay_drops(self):
         rec = _healthy_record(metrics={
             "bst_relay_dropped_total": 5.0,
