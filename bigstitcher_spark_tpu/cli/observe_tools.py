@@ -91,6 +91,9 @@ def _render_top(status: dict, jobs: list[dict]) -> str:
         f"fds {proc.get('open_fds', '?')}",
         f"caches: chunk {_hit_ratio(cc)} hit "
         f"({cc.get('entries', 0)} entries, {_fmt_bytes(cc.get('bytes', 0))})"
+        f"  disk-tier {_fmt_bytes((cc.get('disk') or {}).get('bytes', 0))}"
+        f" ({_fmt_bytes((cc.get('disk') or {}).get('hit_bytes', 0))}"
+        f" served)  prefetch {_hit_ratio(cc.get('prefetch') or {})} hit"
         f"  compiled-fn warm {cf.get('warm_hits', 0)}"
         f" / cold {cf.get('cold_builds', 0)}",
         f"inflight: {_fmt_bytes(infl.get('bytes', 0))} now, "
@@ -146,8 +149,8 @@ def _render_cluster(doc: dict) -> str:
         f"  stall timeout {col.get('stall_timeout_s')}s",
         "",
         f"{'HOST':<18} {'RANK':>4}  {'STATE':<9} {'AGE':>6} "
-        f"{'PROGRESS':<24} {'CACHE':>6} {'PAIR':>6} "
-        f"{'INFLIGHT-HW':>11} {'DROP':>5}",
+        f"{'PROGRESS':<24} {'CACHE':>6} {'DISK':>7} {'PF':>6} "
+        f"{'PAIR':>6} {'INFLIGHT-HW':>11} {'DROP':>5}",
     ]
     for r in doc.get("ranks", []):
         p = r.get("progress") or {}
@@ -161,10 +164,13 @@ def _render_cluster(doc: dict) -> str:
         infl = (r.get("inflight") or {}).get("highwater_bytes")
         drop = r.get("dropped") or {}
         dropn = (drop.get("queue", 0) or 0) + (drop.get("conn", 0) or 0)
+        cc = r.get("chunk_cache") or {}
         lines.append(
             f"{r.get('host', '?'):<18} {r.get('process_index', '?'):>4}  "
             f"{state:<9} {r.get('age_s', '?'):>5}s {prog:<24} "
-            f"{_hit_ratio(r.get('chunk_cache') or {}):>6} "
+            f"{_hit_ratio(cc):>6} "
+            f"{_fmt_bytes((cc.get('disk') or {}).get('bytes', 0)):>7} "
+            f"{_hit_ratio(cc.get('prefetch') or {}):>6} "
             f"{_pair_util(r.get('pair_util') or {}):>6} "
             f"{_fmt_bytes(infl):>11} {dropn:>5}")
     if not doc.get("ranks"):

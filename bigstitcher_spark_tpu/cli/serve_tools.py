@@ -224,6 +224,19 @@ def jobs_cmd(socket_path, as_json):
                f"({cc.get('bytes', 0)} B, {cc.get('hits', 0)} hits) | "
                f"compiled-fn warm {cf.get('warm_hits', 0)} / "
                f"cold {cf.get('cold_builds', 0)}")
+    disk = cc.get("disk") or {}
+    pf = cc.get("prefetch") or {}
+    if disk.get("entries") or pf.get("hits") or pf.get("misses"):
+        # tiered-IO warmth: the gateway's cache-affinity routing picks
+        # daemons by exactly these ratios
+        looked = (pf.get("hits", 0) or 0) + (pf.get("misses", 0) or 0)
+        ratio = (f"{(pf.get('hits', 0) or 0) / looked * 100:.0f}%"
+                 if looked else "-")
+        click.echo(f"tiers: disk {disk.get('entries', 0)} chunks "
+                   f"({disk.get('bytes', 0)} B, "
+                   f"{disk.get('hit_bytes', 0)} B served) | "
+                   f"prefetch {ratio} hit "
+                   f"({pf.get('hit_bytes', 0)} B served)")
     for j in resp["jobs"]:
         line = (f"{j['id']:>6}  {j['state']:<10} {j['tool']:<24} "
                 f"prio {j['priority']} share {j['share']} "

@@ -131,6 +131,46 @@ _knob("BST_S3_REGION", "str", None,
 _knob("BST_S3_ENDPOINT", "str", None,
       "Custom S3-protocol endpoint (MinIO / on-prem stores / test fakes); "
       "io.uris.set_s3_endpoint() overrides at runtime.")
+_knob("BST_REMOTE_CACHE", "str", "run",
+      "Decoded-chunk LRU eligibility of REMOTE object stores (s3/gs). "
+      "'run' (default) caches their chunks keyed by a per-run pin plus "
+      "the dataset metadata object's content signature — coherent "
+      "against this process's own writes (generation-bump invalidation) "
+      "and against any store mutation that rewrites the metadata object; "
+      "an external process mutating chunk objects mid-run is outside the "
+      "contract (documented coherence window, README 'Configuration'). "
+      "'off' restores the historical bypass bit-identically.",
+      choices=("run", "off"))
+_knob("BST_PREFETCH_BYTES", "bytes", 256 << 20,
+      "Byte budget of the async chunk prefetcher (io/prefetch.py): the "
+      "mesh/pairsched/dag drivers enqueue their known FUTURE work items' "
+      "source boxes and a small thread pool fetches them into the "
+      "decoded-chunk LRU ahead of the consumer, bounded by this many "
+      "fetched-but-unconsumed bytes. 0 disables prefetch entirely "
+      "(drivers take the exact pre-prefetch paths).",
+      tunable=Tunable(lo=32 << 20, hi=8 << 30))
+_knob("BST_PREFETCH_THREADS", "int", 4,
+      "Worker threads of the async chunk prefetcher; 0 disables prefetch "
+      "like BST_PREFETCH_BYTES=0.",
+      tunable=Tunable(lo=1, hi=32))
+_knob("BST_DISK_TIER_BYTES", "bytes", 0,
+      "Byte budget of the NVMe/local-disk spill tier under the decoded-"
+      "chunk LRU (io/disktier.py): entries the memory LRU evicts under "
+      "budget pressure spill to a run-scoped local directory and promote "
+      "back on hit instead of re-fetching from the (possibly remote) "
+      "store. 0 (default) disables the tier bit-identically.",
+      tunable=Tunable(lo=256 << 20, hi=1 << 40))
+_knob("BST_DISK_TIER_DIR", "str", None,
+      "Directory of the disk spill tier (put it on local NVMe). Default: "
+      "a bst-disktier-<pid> directory under the system temp dir, removed "
+      "at process exit.")
+_knob("BST_UPLOAD_THREADS", "int", 8,
+      "Concurrent upload workers for direct writes to REMOTE object "
+      "stores (s3/gs): a multi-chunk box splits per storage chunk and "
+      "the chunk puts run through a bounded pool with retry/backoff "
+      "(parallel/retry.py) instead of one serialized tensorstore write. "
+      "0 or 1 restores the single serialized write path.",
+      tunable=Tunable(lo=1, hi=64))
 
 # -- device memory / dispatch windows --------------------------------------
 _knob("BST_INFLIGHT_BYTES", "bytes", None,

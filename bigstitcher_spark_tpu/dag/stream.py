@@ -586,6 +586,66 @@ class StreamRegistry:
         ents = self._handoff.pop_many([(root, path, p) for p in need])
         if ents:
             self._spill(ents)
+        self._feed_prefetch(ds, tok, root, path, need)
+
+    def box_ready(self, ds, offset, shape) -> bool:
+        """Non-blocking gate probe for the async prefetcher
+        (io/prefetch.py): True when prefetching this box now is safe.
+        Datasets that are not streamed edges always are; a streamed
+        edge's box is ready only when every touched chunk has LOCAL
+        coverage — an unpublished chunk would cache container zeros, a
+        remote-owned one would cache a peer's bytes this rank's
+        container never held."""
+        if not self._edges:
+            return True
+        key = _ds_key(ds)
+        if key is None:
+            return True
+        root, path = key
+        edge = self._edges.get(root)
+        if edge is None or not edge.stream:
+            return True
+        geo = _geometry(ds)
+        if geo is None:
+            return False
+        block, _dims = geo
+        if len(block) != len(tuple(offset)):
+            return False
+        need = _touched_positions(offset, shape, block)
+        with self._lock:
+            cov = self._coverage.get((root, path)) or ()
+            return all(p in cov for p in need)
+
+    def _feed_prefetch(self, ds, tok, root, path, just_read) -> None:
+        """Feed the async prefetcher the published-but-unconsumed blocks
+        this consumer is still OWED on the edge: those are its known
+        future gated reads, already written by the producer, so decoding
+        them now overlaps the consumer's current block's compute. No-op
+        (one enabled() check) while the prefetcher is off."""
+        from ..io import prefetch as _prefetch
+
+        if not _prefetch.enabled():
+            return
+        done = set(just_read)
+        with self._lock:
+            owed = [k[2] for k, ent in self._pending.items()
+                    if k[0] == root and k[1] == path
+                    and tok in ent[1] and k[2] not in done]
+        if not owed:
+            return
+        geo = _geometry(ds)
+        if geo is None:
+            return
+        block, dims = geo
+        nd = len(block)
+        boxes = []
+        for pos in owed[:16]:   # enough to stay ahead of one consumer
+            lo = [pos[d] * block[d] for d in range(nd)]
+            shp = [min(block[d], dims[d] - lo[d]) for d in range(nd)]
+            if all(s > 0 for s in shp):
+                boxes.append((ds, lo, shp))
+        if boxes:
+            _prefetch.submit_boxes(boxes)
 
     def _wait_and_consume(self, edge, tok, root, path, need) -> None:
         with self._cond:

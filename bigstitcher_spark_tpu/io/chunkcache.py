@@ -27,10 +27,22 @@ propagates to HBM-resident copies too.
 
 ``BST_CHUNK_CACHE_BYTES`` sets the budget (default 1 GiB); ``0`` disables
 caching entirely — reads then take exactly the pre-cache code paths, so
-cache-off output is bit-identical by construction. Only process-coherent
-stores are cached (local filesystems, ``memory://`` roots, single-process
-HDF5); remote object stores (s3/gs) are not, because another process can
-mutate them without any host-visible signal.
+cache-off output is bit-identical by construction.
+
+Eligibility: local filesystems, ``memory://`` roots and single-process
+HDF5 always participate. Remote object stores (s3/gs) participate under
+``BST_REMOTE_CACHE=run`` (the default): their entries fold a per-run pin
+plus the dataset metadata object's content hash into ``meta_sig``, so the
+coherence window is explicit — this process's own writes invalidate via
+the generation bumps below, while an EXTERNAL process mutating chunk
+objects mid-run is outside the contract (``off`` restores the historical
+remote bypass bit-identically; see README "Configuration").
+
+Under this LRU sits an optional disk spill tier (io/disktier.py,
+``BST_DISK_TIER_BYTES``): budget-pressure evictions spill to a run-scoped
+local directory and ``get`` promotes them back on the next miss, so
+working sets larger than RAM stop re-fetching from the store. All
+invalidation paths pass through to it.
 """
 
 from __future__ import annotations
@@ -40,6 +52,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from . import disktier
 from .. import config
 from ..observe import metrics as _metrics
 
@@ -64,6 +77,17 @@ def enabled() -> bool:
     return budget_bytes() > 0
 
 
+# consumption hook of the async prefetcher (io/prefetch.py): installed once
+# when a prefetcher first activates, then permanent — the hook itself
+# short-circuits when nothing is tracked, so the cost off-prefetch is the
+# _DAG_HOOKS pattern's one list-load + None check
+_PREFETCH_HOOK: list = [None]
+
+
+def set_prefetch_hook(fn) -> None:
+    _PREFETCH_HOOK[0] = fn
+
+
 class ChunkCache:
     """Thread-safe byte-budgeted LRU over decoded chunk arrays.
 
@@ -77,19 +101,48 @@ class ChunkCache:
         self._by_dataset: dict[tuple, set] = {}
         self._generations: dict[tuple, int] = {}
         self._bytes = 0
+        # evictions between leaving the LRU and landing on the disk tier:
+        # keeping them readable here closes the window where a concurrent
+        # lookup finds the chunk in NEITHER tier and re-fetches it remotely
+        self._spilling: dict[tuple, np.ndarray] = {}
 
     # -- lookup ------------------------------------------------------------
 
     def get(self, key: tuple) -> np.ndarray | None:
         with self._lock:
             arr = self._entries.get(key)
+            if arr is not None:
+                self._entries.move_to_end(key)
+            elif self._spilling:
+                arr = self._spilling.get(key)
+        if arr is None:
+            # memory miss: promote from the disk spill tier when it holds
+            # the key (has_entries() keeps the never-spilled path exactly
+            # the pre-tier code)
+            tier = disktier.get_tier()
+            if tier.has_entries():
+                arr = tier.load(key)
+                if arr is not None:
+                    self.put(key, arr, record_miss=False)
             if arr is None:
                 _MISSES.inc()
                 return None
-            self._entries.move_to_end(key)
+        hook = _PREFETCH_HOOK[0]
+        if hook is not None:
+            hook(key, arr.nbytes)
         _HITS.inc()
         _HIT_BYTES.inc(arr.nbytes)
         return arr
+
+    def peek(self, key: tuple) -> bool:
+        """Non-counting residency probe (memory OR disk tier): the
+        prefetcher plans with it, so probes never skew hit/miss stats,
+        never touch LRU order and never fire the consumption hook."""
+        with self._lock:
+            if key in self._entries or key in self._spilling:
+                return True
+        tier = disktier.get_tier()
+        return tier.has_entries() and tier.contains(key)
 
     def put(self, key: tuple, arr: np.ndarray,
             record_miss: bool = True) -> None:
@@ -116,13 +169,25 @@ class ChunkCache:
                 k, v = self._entries.popitem(last=False)
                 self._by_dataset.get(k[0], set()).discard(k)
                 self._bytes -= v.nbytes
-                evicted.append(v.nbytes)
+                evicted.append((k, v))
+            spill_down = bool(evicted) and disktier.enabled()
+            if spill_down:
+                for k, v in evicted:
+                    self._spilling[k] = v
             self._update_gauges()
         if record_miss:
             _MISS_BYTES.inc(arr.nbytes)
-        for nb in evicted:
+        for _k, v in evicted:
             _EVICTIONS.inc()
-            _EVICT_BYTES.inc(nb)
+            _EVICT_BYTES.inc(v.nbytes)
+        if spill_down:
+            # budget-pressure evictions drop to the disk tier (outside the
+            # lock: file IO must never serialize the hot path; the
+            # _spilling map keeps them readable until the files land)
+            disktier.get_tier().spill(evicted)
+            with self._lock:
+                for k, _v in evicted:
+                    self._spilling.pop(k, None)
 
     # -- invalidation ------------------------------------------------------
 
@@ -133,35 +198,43 @@ class ChunkCache:
 
         Runs even when caching is disabled: the generation counter is how
         device-side caches observe writes, and it must advance regardless
-        of whether host chunks were retained."""
+        of whether host chunks were retained. Spilled entries drop with
+        the memory ones — a generation bump reaches the disk tier too."""
+        wanted = (None if chunk_positions is None
+                  else {tuple(int(v) for v in p) for p in chunk_positions})
         with self._lock:
             self._generations[dataset_key] = (
                 self._generations.get(dataset_key, 0) + 1)
             keys = self._by_dataset.get(dataset_key)
-            if not keys:
-                return
-            if chunk_positions is None:
-                doomed = list(keys)
-            else:
-                wanted = {tuple(int(v) for v in p) for p in chunk_positions}
-                doomed = [k for k in keys if k[2] in wanted]
-            for k in doomed:
-                v = self._entries.pop(k, None)
-                keys.discard(k)
-                if v is not None:
-                    self._bytes -= v.nbytes
-                    _INVALIDATIONS.inc()
-            if not keys:
-                self._by_dataset.pop(dataset_key, None)
-            self._update_gauges()
+            if keys:
+                doomed = (list(keys) if wanted is None
+                          else [k for k in keys if k[2] in wanted])
+                for k in doomed:
+                    v = self._entries.pop(k, None)
+                    keys.discard(k)
+                    if v is not None:
+                        self._bytes -= v.nbytes
+                        _INVALIDATIONS.inc()
+                if not keys:
+                    self._by_dataset.pop(dataset_key, None)
+                self._update_gauges()
+            if self._spilling:
+                for k in [k for k in self._spilling
+                          if k[0] == dataset_key
+                          and (wanted is None or k[2] in wanted)]:
+                    self._spilling.pop(k, None)
+        tier = disktier.get_tier()
+        if tier.has_entries():
+            tier.drop(dataset_key, wanted)
 
     def invalidate_prefix(self, root, path_prefix: str) -> None:
         """Drop every dataset under ``path_prefix`` of ``root`` (store-level
         remove / recreate; an empty prefix clears the whole root)."""
         prefix = path_prefix.strip("/")
         with self._lock:
-            victims = [dk for dk in set(self._by_dataset)
-                       | set(self._generations)
+            candidates = (set(self._by_dataset) | set(self._generations)
+                          | set(disktier.get_tier().dataset_keys()))
+            victims = [dk for dk in candidates
                        if dk[0] == root
                        and (not prefix
                             or dk[1].strip("/") == prefix
@@ -179,19 +252,27 @@ class ChunkCache:
         with self._lock:
             self._entries.clear()
             self._by_dataset.clear()
+            self._spilling.clear()
             self._bytes = 0
             self._update_gauges()
+        disktier.get_tier().clear()
 
     def stats(self) -> dict:
         """Residency + lifetime hit/miss totals — the `bst serve` daemon's
         cache-warmth surface (`bst jobs` prints it so a client can see WHY
-        a repeat submit is cheap)."""
+        a repeat submit is cheap). Carries the disk spill tier and the
+        async prefetcher as sub-dicts, so relay snapshots, `/status` and
+        `bst top` report the whole tiered-IO warmth picture per process."""
         with self._lock:
             resident = {"entries": len(self._entries), "bytes": self._bytes}
+        from . import prefetch as _prefetch
+
         return {**resident,
                 "hits": _HITS.value, "misses": _MISSES.value,
                 "hit_bytes": _HIT_BYTES.value,
-                "evictions": _EVICTIONS.value}
+                "evictions": _EVICTIONS.value,
+                "disk": disktier.get_tier().stats(),
+                "prefetch": _prefetch.stats()}
 
     def _update_gauges(self) -> None:
         _CUR_BYTES.set(self._bytes)

@@ -26,10 +26,38 @@ import numpy as np
 import tensorstore as ts
 
 from . import chunkcache, uris
-from .. import config
+from .. import config, profiling
 from ..observe import events as _events
 from ..observe import metrics as _metrics
 from ..observe import trace as _trace
+
+# remote-object-store traffic, counted SEPARATELY from the per-impl io
+# counters above: these are the bytes that actually crossed the network
+# (or the fake-S3 loopback), the denominator every warm-cache / prefetch
+# claim in bench measure_cloud and scripts/cloud_smoke.sh is checked
+# against ("warm rerun reads 0 remote bytes" is asserted on these)
+_REMOTE_READ_BYTES = _metrics.counter("bst_io_remote_read_bytes_total")
+_REMOTE_WRITE_BYTES = _metrics.counter("bst_io_remote_write_bytes_total")
+_PREFETCH_BYTES = _metrics.counter("bst_io_prefetch_bytes_total")
+_UPLOAD_INFLIGHT = _metrics.gauge("bst_io_upload_inflight")
+
+# per-run pin folded into remote cache signatures (BST_REMOTE_CACHE=run):
+# bumping it orphans every remote-keyed cache entry at once, the coarse
+# invalidation lever for "another writer may have touched the bucket"
+_REMOTE_PIN = [0]
+
+
+def remote_pin() -> int:
+    return _REMOTE_PIN[0]
+
+
+def bump_remote_pin() -> int:
+    """Start a new remote-cache coherence window: every cached remote
+    chunk keyed under the old pin becomes unreachable (and ages out of
+    the LRU). The serve daemon calls this at each job start; a one-shot
+    CLI process is a single window (pin 0) its whole life."""
+    _REMOTE_PIN[0] += 1
+    return _REMOTE_PIN[0]
 
 # one (bytes, chunk-ops) counter pair per (op, path-taken) — cached so the
 # hot path pays one dict lookup + two lock'd adds per box read/write, which
@@ -241,24 +269,35 @@ class Dataset:
         return (root, self.path.strip("/"))
 
     def _cacheable(self) -> bool:
-        """Only process-coherent stores participate: local filesystems,
+        """Process-coherent stores always participate: local filesystems,
         in-process ``memory://`` roots, and single-process HDF5. Remote
-        object stores can change under another process with no
-        host-visible signal, so they bypass the cache."""
+        object stores (s3/gs) participate under ``BST_REMOTE_CACHE=run``
+        (the default) with a run-pinned signature — see ``_cache_sig`` —
+        while ``off`` restores the historical bypass bit-identically."""
         store = self.store
         if store is None:
             return False
         if getattr(store, "format", None) == StorageFormat.HDF5:
             return True
-        return bool(getattr(store, "is_local", False)
-                    or str(getattr(store, "root", "")
-                           ).startswith("memory://"))
+        if (getattr(store, "is_local", False)
+                or str(getattr(store, "root", "")).startswith("memory://")):
+            return True
+        return (getattr(store, "is_remote_object", False)
+                and config.get_str("BST_REMOTE_CACHE") == "run")
 
     def _cache_sig(self):
-        """Metadata-file signature folded into cache keys — the same
-        (mtime_ns, size) identity ``_meta_file_cached`` uses, so an
-        out-of-band recreate at this path orphans the old entries."""
+        """Metadata signature folded into cache keys. Local stores use the
+        metadata file's (mtime_ns, size) — the same identity
+        ``_meta_file_cached`` uses — so an out-of-band recreate at this
+        path orphans the old entries. Remote object stores fold the
+        per-run pin plus the metadata object's content hash/size instead
+        (one conditional GET per open, memoized per pin): this process's
+        own writes still invalidate precisely via the generation bumps,
+        and ``bump_remote_pin`` bounds the external-writer coherence
+        window."""
         store = self.store
+        if getattr(store, "is_remote_object", False):
+            return self._remote_cache_sig()
         if not getattr(store, "is_local", False) or not hasattr(store, "_kvpath"):
             return None
         name = ("attributes.json"
@@ -269,6 +308,32 @@ class Dataset:
             return (st.st_mtime_ns, st.st_size)
         except OSError:
             return None
+
+    def _remote_cache_sig(self):
+        """Remote signature ("remote", pin, md5-of-metadata, size), fetched
+        once per (dataset instance, pin) — re-opened datasets re-read it,
+        so a REPLACED remote dataset (new .zarray/attributes.json bytes)
+        never collides with stale cached chunks."""
+        pin = remote_pin()
+        memo = getattr(self, "_remote_sig_memo", None)
+        if memo is not None and memo[0] == pin:
+            return memo[1]
+        name = ("attributes.json"
+                if getattr(self.store, "format", None) == StorageFormat.N5
+                else ".zarray")
+        rel = f"{self.path.strip('/')}/{name}" if self.path.strip("/") else name
+        try:
+            raw = self.store._read_obj(rel)
+        except Exception:
+            raw = None
+        if raw is None:
+            sig = None  # unreadable metadata: share nothing across readers
+        else:
+            import hashlib
+
+            sig = ("remote", pin, hashlib.md5(raw).hexdigest(), len(raw))
+        self._remote_sig_memo = (pin, sig)
+        return sig
 
     def _cached_read(self, offset: Sequence[int],
                      shape: Sequence[int]) -> np.ndarray | None:
@@ -391,6 +456,8 @@ class Dataset:
             futs = [self._ts[sel].read() for sel in sels]
             chunks = [np.asarray(f.result()) for f in futs]
             via = "tensorstore"
+            if getattr(self.store, "is_remote_object", False):
+                _REMOTE_READ_BYTES.inc(sum(int(c.nbytes) for c in chunks))
         else:
             chunks = [np.asarray(self._ts[sel]) for sel in sels]
             via = "h5py"
@@ -418,6 +485,72 @@ class Dataset:
         chunkcache.get_cache().invalidate(self._cache_key(),
                                           itertools.product(*grids))
 
+    def prefetch_box(self, offset: Sequence[int],
+                     shape: Sequence[int]) -> list:
+        """Decode the chunks a FUTURE read of this box will need into the
+        decoded LRU, off the consumer's critical path (io/prefetch.py
+        workers call this). Bypasses the DAG read gate (a non-blocking
+        ``box_ready`` probe skips unpublished streamed blocks instead of
+        waiting on them) and records no read-path io counters — the
+        prefetcher attributes its own traffic. Returns the
+        ``[(cache_key, nbytes), ...]`` it inserted (empty when everything
+        was already resident or the dataset is ineligible)."""
+        if not (chunkcache.enabled() and self._cacheable()):
+            return []
+        hooks = _DAG_HOOKS[0]
+        if hooks is not None:
+            ready = getattr(hooks, "box_ready", None)
+            if ready is not None and not ready(self, offset, shape):
+                return []
+        try:
+            block = self.block_size
+            dims = self.shape
+        except Exception:
+            return []
+        if not block or any(int(b) <= 0 for b in block):
+            return []
+        ndim = len(dims)
+        off = [int(o) for o in offset]
+        shp = [int(s) for s in shape]
+        if len(off) != ndim or len(shp) != ndim:
+            return []
+        if any(o < 0 or s <= 0 or o + s > dims[d]
+               for d, (o, s) in enumerate(zip(off, shp))):
+            return []
+        if self._native_n5_eligible() is None and (
+                self._ts is None or not hasattr(self._ts, "read")):
+            return []  # h5py handles are not thread-safe: never prefetch
+        cc = chunkcache.get_cache()
+        dkey = self._cache_key()
+        sig = self._cache_sig()
+        import itertools
+
+        grids = [range(off[d] // block[d],
+                       (off[d] + shp[d] - 1) // block[d] + 1)
+                 for d in range(ndim)]
+        misses = [pos for pos in itertools.product(*grids)
+                  if not cc.peek((dkey, sig, pos))]
+        if not misses:
+            return []
+        itemsize = np.dtype(self.dtype).itemsize
+        est = sum(int(np.prod([min(block[d], dims[d] - p[d] * block[d])
+                               for d in range(ndim)])) * itemsize
+                  for p in misses)
+        nbytes = 0
+        inserted = []
+        with profiling.span("io.prefetch", item=self.path, nbytes=est):
+            got = self._read_chunks(misses)
+            if got is None:
+                return []
+            _via, chunks = got
+            for pos, chunk in zip(misses, chunks):
+                key = (dkey, sig, pos)
+                cc.put(key, chunk, record_miss=False)
+                inserted.append((key, int(chunk.nbytes)))
+                nbytes += int(chunk.nbytes)
+        _PREFETCH_BYTES.inc(nbytes)
+        return inserted
+
     def read(self, offset: Sequence[int], shape: Sequence[int]) -> np.ndarray:
         """Read a box (xyz-first offset/shape) into a numpy array (xyz-first)."""
         hooks = _DAG_HOOKS[0]
@@ -444,6 +577,8 @@ class Dataset:
         if hasattr(self._ts, "read"):
             data = self._ts[sel].read().result()
             via = "tensorstore"
+            if getattr(self.store, "is_remote_object", False):
+                _REMOTE_READ_BYTES.inc(int(np.asarray(data).nbytes))
         else:
             data = self._ts[sel]
             via = "h5py"
@@ -574,16 +709,85 @@ class Dataset:
             raise ValueError(
                 f"{self.path}: native-only dataset (lz4) — writes must "
                 "be block-aligned and dtype-matched")
+        if self._multipart_write(data, offset):
+            return
         sel = self._sel(offset, data.shape)
         if self.reversed_axes:
             data = data.transpose(tuple(range(data.ndim))[::-1])
         if hasattr(self._ts, "read"):
             self._ts[sel].write(np.ascontiguousarray(data)).result()
             via = "tensorstore"
+            if getattr(self.store, "is_remote_object", False):
+                _REMOTE_WRITE_BYTES.inc(int(data.nbytes))
         else:
             self._ts[sel] = data
             via = "h5py"
         _record_io("write", via, data.nbytes, self.path)
+
+    def _multipart_write(self, data: np.ndarray,
+                         offset: Sequence[int]) -> bool:
+        """Remote direct writes: split a multi-chunk box along storage-chunk
+        boundaries and push the per-chunk puts through a bounded concurrent
+        pool with retry/backoff (parallel/retry.py) instead of one
+        serialized tensorstore write — each part touches exactly one chunk,
+        so concurrent parts never contend and a retried part re-puts its
+        whole object (no partial chunk is ever visible). Returns False
+        (caller takes the ordinary single-write path) for non-remote
+        stores, ``BST_UPLOAD_THREADS<=1``, or single-chunk boxes."""
+        if not getattr(self.store, "is_remote_object", False):
+            return False
+        threads = config.get_int("BST_UPLOAD_THREADS")
+        if threads <= 1 or not hasattr(self._ts, "read"):
+            return False
+        try:
+            block = self.block_size
+            dims = self.shape
+        except Exception:
+            return False
+        ndim = data.ndim
+        if len(block) != ndim or any(int(b) <= 0 for b in block):
+            return False
+        off = [int(o) for o in offset]
+        import itertools
+
+        grids = [range(off[d] // block[d],
+                       (off[d] + data.shape[d] - 1) // block[d] + 1)
+                 for d in range(ndim)]
+        positions = list(itertools.product(*grids))
+        if len(positions) <= 1:
+            return False
+        rev = tuple(range(ndim))[::-1]
+        parts = []
+        for pos in positions:
+            lo = [max(off[d], pos[d] * block[d]) for d in range(ndim)]
+            hi = [min(off[d] + data.shape[d], (pos[d] + 1) * block[d],
+                      dims[d]) for d in range(ndim)]
+            if any(hi[d] <= lo[d] for d in range(ndim)):
+                continue
+            src = tuple(slice(lo[d] - off[d], hi[d] - off[d])
+                        for d in range(ndim))
+            parts.append((lo, data[src]))
+
+        def put_one(item):
+            lo, part = item
+            psel = self._sel(lo, part.shape)
+            pdata = part.transpose(rev) if self.reversed_axes else part
+            _UPLOAD_INFLIGHT.inc()
+            try:
+                with profiling.span("io.upload", item=self.path,
+                                    nbytes=int(part.nbytes)):
+                    _upload_one(self, psel, np.ascontiguousarray(pdata))
+            finally:
+                _UPLOAD_INFLIGHT.inc(-1)
+
+        from ..parallel.retry import run_with_retry
+
+        run_with_retry(parts, put_one, max_retries=4, delay_s=0.25,
+                       label="upload", verbose=False,
+                       threads=min(int(threads), len(parts)))
+        _record_io("write", "tensorstore", data.nbytes, self.path)
+        _REMOTE_WRITE_BYTES.inc(int(data.nbytes))
+        return True
 
     def _native_n5_eligible(self) -> str | None:
         """Shared native-codec eligibility gate for N5 reads AND writes:
@@ -741,6 +945,12 @@ class Dataset:
         return self.read((0,) * len(self.shape), self.shape)
 
 
+def _upload_one(ds: "Dataset", sel, part: np.ndarray) -> None:
+    """One multipart upload part — module-level so tests can inject
+    transient put failures (tests/test_tiered_io.py monkeypatches this)."""
+    ds._ts[sel].write(part).result()
+
+
 class ChunkStore:
     """A root N5/ZARR container on a local path or cloud URI.
 
@@ -751,6 +961,10 @@ class ChunkStore:
     def __init__(self, root: str | os.PathLike, fmt: StorageFormat):
         self.is_local = not uris.has_scheme(root)
         self.root = uris.strip_file_scheme(root) if self.is_local else str(root)
+        # remote OBJECT stores (network round trip per chunk) as opposed to
+        # merely non-local roots like memory:// — the tiered-IO engine keys
+        # prefetch/remote-cache/multipart eligibility on this
+        self.is_remote_object = str(self.root).startswith(("s3://", "gs://"))
         self.format = StorageFormat(fmt)
         if self.format == StorageFormat.HDF5:
             raise ValueError("use Hdf5Store for HDF5")

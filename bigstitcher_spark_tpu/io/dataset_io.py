@@ -469,6 +469,34 @@ class ViewLoader:
             out[sl] = data
         return out
 
+    def prefetch_box(self, view: ViewId, level: int,
+                     offset: Sequence[int], shape: Sequence[int]):
+        """``(dataset, clipped offset, clipped shape)`` naming the chunk
+        read a later ``read_block(view, level, offset, shape)`` will
+        perform — what the async prefetcher feeds (io/prefetch.py) hand
+        to ``Dataset.prefetch_box``. None when the clip is empty or the
+        view is not chunkstore-backed (TIFF/CZI stacks, in-memory
+        stand-ins have no chunk grid to read ahead)."""
+        try:
+            ds = self.open(view, level)
+        except Exception:
+            return None
+        # clip in the view's own coordinates first (read_block's clip) …
+        full = ds.shape
+        lo = [max(0, int(o)) for o in offset]
+        hi = [min(int(f), int(o) + int(s))
+              for f, o, s in zip(full, offset, shape)]
+        if any(h <= l for l, h in zip(lo, hi)):
+            return None
+        # … then unwrap a split-view crop window onto its source dataset
+        if isinstance(ds, _CropDataset):
+            lo = [l + d for l, d in zip(lo, ds._off)]
+            hi = [h + d for h, d in zip(hi, ds._off)]
+            ds = ds._ds
+        if not hasattr(ds, "prefetch_box"):
+            return None
+        return ds, tuple(lo), tuple(h - l for l, h in zip(lo, hi))
+
 
 def best_mipmap_level(
     factors: list[list[int]], target_downsampling: Sequence[float],

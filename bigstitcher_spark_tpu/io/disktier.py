@@ -1,0 +1,269 @@
+"""NVMe/local-disk spill tier under the decoded-chunk LRU.
+
+The memory LRU (io/chunkcache.py) is the only thing standing between a
+consumer and a chunk re-decode; once the working set outgrows
+``BST_CHUNK_CACHE_BYTES`` every eviction is a future re-fetch — from a
+REMOTE object store, a full network round trip. This tier catches those
+evictions: entries the memory LRU pushes out under budget pressure are
+serialized to a byte-budgeted run-scoped local directory
+(``BST_DISK_TIER_BYTES`` / ``BST_DISK_TIER_DIR``) and promoted back into
+the memory LRU on the next miss, so working sets larger than RAM stop
+paying the store again. It generalizes the dag executor's per-spec
+``"backing": "disk"`` spill to EVERY cached dataset.
+
+Tiering is INCLUSIVE: ``load`` promotes a copy and leaves the disk entry
+in place, so a chunk bouncing between tiers is never momentarily in
+neither (a concurrent prefetch probe in that gap would re-fetch it from
+the remote store), and re-evicting a promoted chunk skips the rewrite —
+the bytes on disk are still current, because any write that could change
+them invalidates both tiers first. Keys are the chunk cache's own
+``(dataset_key, meta_sig, chunk_pos)`` tuples, so write invalidation and
+generation bumps drop disk entries through the same calls that drop
+memory entries (the chunk cache forwards them). Files are anonymous ``<seq>.npy`` blobs named only by the
+in-memory index; the directory is deleted at process exit — the tier is
+run-scoped by construction, never a cross-run cache.
+
+``BST_DISK_TIER_BYTES=0`` (the default) disables the tier: nothing is
+ever written, and the chunk cache's probe short-circuits on an empty
+index, so the memory-only paths are exactly the pre-tier code.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import shutil
+import tempfile
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from .. import config, profiling
+from ..observe import metrics as _metrics
+
+_HIT_BYTES = _metrics.counter("bst_io_disktier_hit_bytes_total")
+_SPILL_BYTES = _metrics.counter("bst_io_disktier_spill_bytes_total")
+_EVICT_BYTES = _metrics.counter("bst_io_disktier_evict_bytes_total")
+_CUR_BYTES = _metrics.gauge("bst_io_disktier_bytes")
+_CUR_ENTRIES = _metrics.gauge("bst_io_disktier_entries")
+
+
+def budget_bytes() -> int:
+    return config.get_bytes("BST_DISK_TIER_BYTES")
+
+
+def enabled() -> bool:
+    return budget_bytes() > 0
+
+
+class DiskTier:
+    """Thread-safe byte-budgeted LRU of spilled decoded chunks on disk.
+
+    The index (key -> (file path, nbytes)) is authoritative; file IO
+    always happens OUTSIDE the lock (an entry is unreachable the moment
+    it leaves the index, so a popped path can be read or unlinked without
+    racing a concurrent spill, which always allocates a fresh name)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._index: OrderedDict[tuple, tuple[str, int]] = OrderedDict()
+        self._by_dataset: dict[tuple, set] = {}
+        self._bytes = 0
+        self._seq = 0
+        self._dir: str | None = None
+
+    # -- directory lifecycle -----------------------------------------------
+
+    def _ensure_dir(self) -> str:
+        with self._lock:
+            if self._dir is not None:
+                return self._dir
+            base = config.get_str("BST_DISK_TIER_DIR")
+            if base:
+                d = os.path.join(base, f"bst-disktier-{os.getpid()}")
+                os.makedirs(d, exist_ok=True)
+            else:
+                d = tempfile.mkdtemp(prefix="bst-disktier-")
+            self._dir = d
+        atexit.register(shutil.rmtree, d, True)
+        return d
+
+    def _alloc_path_locked(self) -> str:
+        self._seq += 1
+        return os.path.join(self._dir or "", f"{self._seq:08x}.npy")
+
+    # -- spill / promote ----------------------------------------------------
+
+    def spill(self, items) -> None:
+        """Persist ``[(key, arr), ...]`` (memory-LRU evictions). Oversized
+        arrays are skipped; over-budget insertion evicts oldest entries."""
+        budget = budget_bytes()
+        if budget <= 0 or not items:
+            return
+        self._ensure_dir()
+        for key, arr in items:
+            nb = int(arr.nbytes)
+            if nb > budget:
+                continue
+            with self._lock:
+                if key in self._index:
+                    # promoted earlier and evicted again: the disk copy is
+                    # still current (writes invalidate both tiers), so just
+                    # refresh recency instead of rewriting the file
+                    self._index.move_to_end(key)
+                    continue
+                path = self._alloc_path_locked()
+            try:
+                with profiling.span("io.disktier", stage="spill",
+                                    nbytes=nb):
+                    np.save(path, arr, allow_pickle=False)
+            except OSError:
+                continue  # a full/unwritable spill dir must never fail IO
+            doomed = []
+            with self._lock:
+                old = self._index.pop(key, None)
+                if old is not None:
+                    self._bytes -= old[1]
+                    doomed.append(old)
+                self._index[key] = (path, nb)
+                self._by_dataset.setdefault(key[0], set()).add(key)
+                self._bytes += nb
+                while self._bytes > budget and self._index:
+                    k, ent = self._index.popitem(last=False)
+                    self._by_dataset.get(k[0], set()).discard(k)
+                    self._bytes -= ent[1]
+                    doomed.append(ent)
+                    _EVICT_BYTES.inc(ent[1])
+                self._update_gauges_locked()
+            _SPILL_BYTES.inc(nb)
+            for p, _nb in doomed:
+                _unlink(p)
+
+    def load(self, key: tuple) -> np.ndarray | None:
+        """Return a spilled chunk (the caller promotes it back into the
+        memory LRU), or None on miss. The disk entry STAYS resident —
+        removing it here would open a window where the chunk is in
+        neither tier and a concurrent prefetch probe re-fetches it from
+        the remote store; a later re-eviction finds it and skips the
+        rewrite instead."""
+        with self._lock:
+            ent = self._index.get(key)
+            if ent is not None:
+                self._index.move_to_end(key)
+        if ent is None:
+            return None
+        path, nb = ent
+        try:
+            with profiling.span("io.disktier", stage="load", nbytes=nb):
+                arr = np.load(path, allow_pickle=False)
+        except (OSError, ValueError):
+            # unreadable blob: drop the index entry so the miss is decisive
+            with self._lock:
+                if self._index.get(key) is ent:
+                    self._index.pop(key, None)
+                    self._by_dataset.get(key[0], set()).discard(key)
+                    self._bytes -= ent[1]
+                    self._update_gauges_locked()
+            _unlink(path)
+            return None
+        _HIT_BYTES.inc(nb)
+        return arr
+
+    def contains(self, key: tuple) -> bool:
+        with self._lock:
+            return key in self._index
+
+    def has_entries(self) -> bool:
+        """Cheap unlocked probe: False keeps the chunk cache's miss path
+        byte-identical to the pre-tier code when nothing ever spilled."""
+        return bool(self._index)
+
+    # -- invalidation -------------------------------------------------------
+
+    def drop(self, dataset_key: tuple, wanted: set | None = None) -> None:
+        """Drop a dataset's spilled entries (all, or only the chunk
+        positions in ``wanted``) — the chunk cache forwards every write
+        invalidation here so a generation bump reaches the disk tier."""
+        with self._lock:
+            keys = self._by_dataset.get(dataset_key)
+            if not keys:
+                return
+            doomed_keys = (list(keys) if wanted is None
+                           else [k for k in keys if k[2] in wanted])
+            doomed = self._drop_keys_locked(dataset_key, doomed_keys)
+        for p, nb in doomed:
+            _EVICT_BYTES.inc(nb)
+            _unlink(p)
+
+    def drop_prefix(self, root, path_prefix: str) -> None:
+        prefix = path_prefix.strip("/")
+        with self._lock:
+            victims = [dk for dk in list(self._by_dataset)
+                       if dk[0] == root
+                       and (not prefix
+                            or dk[1].strip("/") == prefix
+                            or dk[1].strip("/").startswith(prefix + "/"))]
+            doomed = []
+            for dk in victims:
+                doomed += self._drop_keys_locked(
+                    dk, list(self._by_dataset.get(dk, ())))
+        for p, nb in doomed:
+            _EVICT_BYTES.inc(nb)
+            _unlink(p)
+
+    def _drop_keys_locked(self, dataset_key, keys) -> list:
+        out = []
+        live = self._by_dataset.get(dataset_key, set())
+        for k in keys:
+            ent = self._index.pop(k, None)
+            live.discard(k)
+            if ent is not None:
+                self._bytes -= ent[1]
+                out.append(ent)
+        if not live:
+            self._by_dataset.pop(dataset_key, None)
+        self._update_gauges_locked()
+        return out
+
+    def dataset_keys(self) -> list[tuple]:
+        with self._lock:
+            return list(self._by_dataset)
+
+    def clear(self) -> None:
+        with self._lock:
+            doomed = list(self._index.values())
+            self._index.clear()
+            self._by_dataset.clear()
+            self._bytes = 0
+            self._update_gauges_locked()
+        for p, _nb in doomed:
+            _unlink(p)
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            resident = {"entries": len(self._index), "bytes": self._bytes}
+        return {**resident,
+                "hit_bytes": _HIT_BYTES.value,
+                "spill_bytes": _SPILL_BYTES.value,
+                "evict_bytes": _EVICT_BYTES.value}
+
+    def _update_gauges_locked(self) -> None:
+        _CUR_BYTES.set(self._bytes)
+        _CUR_ENTRIES.set(len(self._index))
+
+
+def _unlink(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+_TIER = DiskTier()
+
+
+def get_tier() -> DiskTier:
+    return _TIER

@@ -1,0 +1,238 @@
+"""Async chunk prefetcher: overlap remote reads with device compute.
+
+Against a remote object store every cold chunk read is a network round
+trip, and the drivers take it synchronously on the consumer's critical
+path (inside ``build()``, inside a pair crop, inside a gated streamed
+read). The drivers all KNOW their future reads, though — the mesh driver
+has batch k+2's source boxes while batch k runs, the pair scheduler has
+the whole dispatch window's crops, the dag executor knows which published
+blocks a streamed consumer is still owed — so this module turns that
+knowledge into read-ahead: feeds submit future boxes, a small pool of
+worker threads decodes them into the shared chunk LRU
+(``Dataset.prefetch_box``), and the consumer's later read becomes a cache
+hit.
+
+Budgeting (``BST_PREFETCH_BYTES``): the prefetcher tracks every byte it
+inserted that has not yet been consumed. Workers pause issuing while the
+tracked backlog sits at the budget, and when new insertions push past it
+the OLDEST tracked entries are untracked and counted as
+``bst_io_prefetch_miss_total`` — prefetched too far ahead of the
+consumer, i.e. wasted read-ahead (the entries themselves stay in the LRU
+and may still hit later; only the prefetcher stops crediting itself).
+Consumption is observed through a hook in ``ChunkCache.get``: a cache hit
+on a tracked key counts ``bst_io_prefetch_hit_total``/``_hit_bytes_total``
+and frees budget. ``BST_PREFETCH_BYTES=0`` (or 0 threads) disables
+everything: submits no-op, no thread ever starts, no hook state changes —
+the exact pre-prefetch code paths.
+
+Workers are plain daemon threads, NOT ``utils.threads`` context-capturing
+ones: the pool is process-lived and must not pin one job's cancel scope
+or config overrides into every later fetch. A fetch that raises is
+dropped silently — prefetch is advisory and must never fail a pipeline.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+
+from . import chunkcache
+from .. import config
+from ..observe import metrics as _metrics
+
+_HITS = _metrics.counter("bst_io_prefetch_hit_total")
+_MISSES = _metrics.counter("bst_io_prefetch_miss_total")
+_HIT_BYTES = _metrics.counter("bst_io_prefetch_hit_bytes_total")
+# incremented by Dataset.prefetch_box (io/chunkstore.py) as it decodes;
+# same registry series, referenced here for the stats() surface
+_BYTES = _metrics.counter("bst_io_prefetch_bytes_total")
+
+
+def budget_bytes() -> int:
+    return config.get_bytes("BST_PREFETCH_BYTES")
+
+
+def threads() -> int:
+    return config.get_int("BST_PREFETCH_THREADS")
+
+
+def enabled() -> bool:
+    return budget_bytes() > 0 and threads() > 0
+
+
+class Prefetcher:
+    """Byte-budgeted read-ahead pool over ``Dataset.prefetch_box``.
+
+    The queue holds thunks — zero-arg callables returning an iterable of
+    ``(dataset, offset, shape)`` boxes — so feeds enqueue cheaply on the
+    hot path and box enumeration runs on a worker thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._tracked: OrderedDict[tuple, int] = OrderedDict()
+        self._tracked_bytes = 0
+        self._inflight = 0
+        self._workers: list[threading.Thread] = []
+        self._stopping = False
+
+    # -- feed API -----------------------------------------------------------
+
+    def submit(self, thunk) -> None:
+        """Enqueue a thunk of future boxes. No-op while disabled."""
+        if not enabled():
+            return
+        self._ensure_workers()
+        with self._cv:
+            self._queue.append(thunk)
+            self._cv.notify()
+
+    def submit_boxes(self, boxes) -> None:
+        """Enqueue concrete ``(dataset, offset, shape)`` triples — one
+        queue entry each, so the pool spreads them across workers instead
+        of fetching the whole list serially on one thread."""
+        for box in boxes:
+            self.submit(lambda b=box: (b,))
+
+    # -- consumption (ChunkCache.get hook) ----------------------------------
+
+    def on_cache_hit(self, key: tuple, nbytes: int) -> None:
+        with self._cv:
+            if key not in self._tracked:
+                return
+            self._untrack_locked(key)
+            self._cv.notify_all()
+        _HITS.inc()
+        _HIT_BYTES.inc(int(nbytes))
+
+    # -- worker side --------------------------------------------------------
+
+    def _ensure_workers(self) -> None:
+        with self._lock:
+            if self._workers or self._stopping:
+                return
+            n = max(1, threads())
+            for i in range(n):
+                t = threading.Thread(target=self._worker, daemon=True,
+                                     name=f"bst-prefetch-{i}")
+                self._workers.append(t)
+        chunkcache.set_prefetch_hook(self.on_cache_hit)
+        for t in self._workers:
+            t.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopping:
+                    self._cv.wait(0.2)
+                if self._stopping:
+                    return
+                thunk = self._queue.popleft()
+                self._inflight += 1
+            try:
+                for box in thunk():
+                    self._fetch_one(box)
+            except Exception:
+                pass  # advisory: a bad feed must never take a worker down
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def _fetch_one(self, box) -> None:
+        ds, offset, shape = box
+        budget = budget_bytes()
+        if budget <= 0:
+            return
+        # pace on the unconsumed backlog: wait (bounded — a consumer that
+        # never shows up must not wedge the pool) for hits to free budget
+        deadline = 10  # x 0.1s
+        with self._cv:
+            while self._tracked_bytes >= budget and deadline > 0:
+                self._cv.wait(0.1)
+                deadline -= 1
+        try:
+            inserted = ds.prefetch_box(offset, shape)
+        except Exception:
+            return
+        if not inserted:
+            return
+        with self._cv:
+            for key, nb in inserted:
+                self._untrack_locked(key)  # re-prefetch refreshes position
+                self._tracked[key] = int(nb)
+                self._tracked_bytes += int(nb)
+            while self._tracked_bytes > budget and self._tracked:
+                # past the read-ahead window: oldest entries were fetched
+                # too early — untrack and count them as wasted prefetch
+                k, _nb = self._tracked.popitem(last=False)
+                self._tracked_bytes -= _nb
+                _MISSES.inc()
+
+    def _untrack_locked(self, key: tuple) -> None:
+        nb = self._tracked.pop(key, None)
+        if nb is not None:
+            self._tracked_bytes -= nb
+
+    # -- lifecycle / introspection ------------------------------------------
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Block until the queue is empty and no fetch is in flight (tests
+        and bench legs use this to make prefetch effects deterministic)."""
+        deadline = timeout_s
+        with self._cv:
+            while self._queue or self._inflight:
+                if deadline <= 0:
+                    return False
+                self._cv.wait(0.1)
+                deadline -= 0.1
+        return True
+
+    def reset(self) -> None:
+        """Drop queued work and all tracking state (between bench legs /
+        tests). Workers stay up; counters are NOT reset."""
+        with self._cv:
+            self._queue.clear()
+            self._tracked.clear()
+            self._tracked_bytes = 0
+            self._cv.notify_all()
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            return {"tracked_bytes": self._tracked_bytes,
+                    "tracked_entries": len(self._tracked),
+                    "queued": len(self._queue),
+                    "workers": len(self._workers)}
+
+
+_PF = Prefetcher()
+
+
+def get_prefetcher() -> Prefetcher:
+    return _PF
+
+
+def submit(thunk) -> None:
+    _PF.submit(thunk)
+
+
+def submit_boxes(boxes) -> None:
+    _PF.submit_boxes(boxes)
+
+
+def drain(timeout_s: float = 30.0) -> bool:
+    return _PF.drain(timeout_s)
+
+
+def reset() -> None:
+    _PF.reset()
+
+
+def stats() -> dict:
+    """Lifetime prefetch effectiveness + live backlog — folded into
+    ``ChunkCache.stats()`` so every warmth surface (`bst jobs`, `bst top`,
+    relay snapshots, `/status`) reports it."""
+    return {**_PF.stats_snapshot(),
+            "hits": _HITS.value, "misses": _MISSES.value,
+            "hit_bytes": _HIT_BYTES.value, "bytes": _BYTES.value}

@@ -683,6 +683,19 @@ def upload_composite_tiles(loader, cp: CompositePlan) -> list:
     import jax
 
     budget = _tile_cache_budget()
+    from ..io import prefetch as _prefetch
+
+    if _prefetch.enabled():
+        # announce every tile read below to the async prefetcher: the
+        # upload loop is serial per view, so later views' chunks fetch
+        # (and decode into the chunk LRU) while earlier tiles upload
+        boxes = []
+        for p in cp.plans:
+            ds = loader.open(p.view, 0)
+            if hasattr(ds, "prefetch_box"):
+                boxes.append((ds, (0,) * len(ds.shape),
+                              tuple(int(s) for s in ds.shape)))
+        _prefetch.submit_boxes(boxes)
     tiles = []
     with profiling.span("fusion.h2d_tiles"):
         h2d = saved = 0
@@ -1049,6 +1062,26 @@ def _fuse_volume_sharded(
                         arrs = arrs[:8]
                 return arrs
 
+            def prefetch_boxes(item, _key=key, _kernel=kernel):
+                # the same source boxes build() will read (io/prefetch.py
+                # feed: batch k+2's crops fetch while batch k computes)
+                block, bg, plans = item
+                boxes = []
+                for p in plans:
+                    if _kernel == "shift":
+                        tlevel = (p.inv_total[:, :3]
+                                  @ np.asarray(bg.min, np.float64)
+                                  + p.inv_total[:, 3])
+                        off = np.floor(tlevel).astype(np.int64)
+                        shp = tuple(int(s) + 1 for s in compute_block)
+                    else:
+                        off, shp = p.patch_offset, _key[1]
+                    b = loader.prefetch_box(
+                        p.view, p.level, tuple(int(o) for o in off), shp)
+                    if b is not None:
+                        boxes.append(b)
+                return boxes
+
             def kernel_call(*stacked):
                 # dispatch only — return the DEVICE arrays and let the work
                 # loop's per-device drains fetch them, so the early-dispatch
@@ -1138,6 +1171,7 @@ def _fuse_volume_sharded(
                 device_consume=(device_consume
                                 if handoff_active() and zarr_ct is None
                                 else None),
+                prefetch_boxes=prefetch_boxes,
             )
             stats.voxels += sum(written.values())
     finally:

@@ -93,17 +93,37 @@ def _make_downsample_kernel_cached(n_dev: int, rel_t):
     return shard_jit(batched, make_mesh(n_dev), n_in=1)
 
 
+def prefetch_src_box(ds, src_off, src_size):
+    """``(ds, clipped offset, clipped shape)`` of a padded source-box read
+    — what the async prefetcher feeds (io/prefetch.py) hand to
+    ``Dataset.prefetch_box``. None when the clip is empty or ``ds`` is
+    not a chunkstore dataset."""
+    if not hasattr(ds, "prefetch_box"):
+        return None
+    dims = ds.shape
+    lo = [max(0, int(o)) for o in src_off]
+    hi = [min(int(d), int(o) + int(s))
+          for d, o, s in zip(dims, src_off, src_size)]
+    if any(h <= l for l, h in zip(lo, hi)):
+        return None
+    return ds, tuple(lo), tuple(h - l for l, h in zip(lo, hi))
+
+
 def run_sharded_downsample(jobs, read_job, write_job, rel, devices=None,
                            io_threads: int = 8, per_dev: int = 4,
                            label: str = "downsample block",
                            multihost: bool = True,
-                           device_drain: bool = False) -> None:
+                           device_drain: bool = False,
+                           prefetch_job=None) -> None:
     """Downsample every (job, src-box) through the mesh. ``read_job(job)``
     returns the raw source box (size = out_block * rel, edge-padded);
     ``write_job(job, data)`` converts + writes. Jobs are bucketed by source
     shape so one compile serves each shape. ``device_drain`` routes each
     device's output shard through its own drain+write worker
-    (parallel.mesh) — only safe for parallel-writer stores, never h5py."""
+    (parallel.mesh) — only safe for parallel-writer stores, never h5py.
+    ``prefetch_job(job) -> [(ds, off, shape), ...]`` names the source
+    boxes for the async prefetcher feed (parallel.mesh ``prefetch_boxes``;
+    advisory, inert while the prefetcher is off)."""
     import jax
 
     n_dev = devices if devices is not None else len(jax.local_devices())
@@ -133,6 +153,7 @@ def run_sharded_downsample(jobs, read_job, write_job, rel, devices=None,
                 out_bytes_per_item=out_vox * 4,  # f32 device output
                 workspace_mult=3.0,              # f32 cast of the input
                 device_drain=device_drain,
+                prefetch_boxes=prefetch_job,
             )
     finally:
         pool.shutdown(wait=True)
@@ -224,12 +245,23 @@ def downsample_pyramid_level(
     def write_job(block: GridBlock, out):
         write3d(_convert_to_dtype(out, dst.dtype), block.offset)
 
+    def prefetch_job(block: GridBlock):
+        src_off = [o * f for o, f in zip(block.offset, rel)]
+        src_size = [s * f for s, f in zip(block.size, rel)]
+        if is_zarr5d:
+            c, t = ct
+            b = prefetch_src_box(src, (*src_off, c, t), (*src_size, 1, 1))
+        else:
+            b = prefetch_src_box(src, src_off, src_size)
+        return [b] if b is not None else []
+
     run_sharded_downsample(grid, read_job, write_job, rel, devices=devices,
                            io_threads=io_threads,
                            # per-device direct chunk writes wherever the
                            # store allows concurrent writers
                            device_drain=getattr(store, "format", None)
-                           != StorageFormat.HDF5)
+                           != StorageFormat.HDF5,
+                           prefetch_job=prefetch_job)
     dt = time.time() - t0
     observe.progress.record_stage(
         f"downsample {dst_info.dataset.strip('/')}",

@@ -196,6 +196,34 @@ def match_pair_intensities(
     return out
 
 
+def _pair_sample_boxes(sd, loader, va, vb, ov):
+    """``(ds, offset, shape)`` source boxes of the two level-patch reads a
+    pair's ``_sample_view`` calls make — the async prefetcher feed
+    (parallel.pairsched ``prefetch_boxes``). The sample grid's pixel extremes
+    sit at overlap corners under an affine model, so the corner-derived box
+    covers the pair's ``read_block`` (over-covering by at most one grid step,
+    clipped by ``prefetch_box``)."""
+    corners = np.array([[ov.min[d] if (i >> d) & 1 == 0 else ov.max[d]
+                         for d in range(3)] for i in range(8)], np.float64)
+    boxes = []
+    for v in (va, vb):
+        inv = invert_affine(sd.model(v))
+        px = corners @ inv[:, :3].T + inv[:, 3]
+        size = np.array(sd.view_size(v), np.float64)
+        px = np.clip(px, 0, size - 1)
+        ds_factors = loader.downsampling_factors(v.setup)
+        lvl = best_mipmap_level(ds_factors, (2, 2, 2))
+        f = np.asarray(ds_factors[lvl], np.float64)
+        lpx = (px - (f - 1) / 2.0) / f
+        lo = np.maximum(np.floor(lpx.min(axis=0)).astype(int) - 1, 0)
+        hi = np.ceil(lpx.max(axis=0)).astype(int) + 2
+        b = loader.prefetch_box(v, lvl, tuple(int(x) for x in lo),
+                                tuple(int(x) for x in hi - lo))
+        if b is not None:
+            boxes.append(b)
+    return boxes
+
+
 def match_intensities(
     sd: SpimData, loader: ViewLoader, views: list[ViewId],
     params: IntensityParams | None = None, progress: bool = True,
@@ -237,8 +265,13 @@ def match_intensities(
         k, va, vb = task.tag
         return match_pair_intensities(sd, loader, va, vb, params, seed=5 + k)
 
+    def prefetch_boxes(task):
+        k, va, vb = task.tag
+        return _pair_sample_boxes(sd, loader, va, vb,
+                                  boxes[va].intersect(boxes[vb]))
+
     outs = run_pair_tasks(tasks, run_one, n_devices=devices,
-                          stage="intensity")
+                          stage="intensity", prefetch_boxes=prefetch_boxes)
     matches: list[CellMatch] = []
     for (va, vb), m in zip(pairs, outs):
         matches.extend(m)

@@ -26,6 +26,7 @@ from ..parallel.retry import run_with_retry
 from ..utils.grid import create_grid
 from .downsample_driver import (
     _convert_to_dtype,
+    prefetch_src_box,
     read_padded,
     run_sharded_downsample,
     validate_pyramid,
@@ -130,10 +131,19 @@ def resave(
             dst = per_view_datasets[v][level]
             dst.write(_convert_to_dtype(out, dst.dtype), blk.offset)
 
+        def prefetch_job(job, level=lvl, f=f):
+            v, blk = job
+            src = per_view_datasets[v][level - 1]
+            b = prefetch_src_box(src,
+                                 [o * x for o, x in zip(blk.offset, f)],
+                                 [s * x for s, x in zip(blk.size, f)])
+            return [b] if b is not None else []
+
         level_jobs = partition_items(level_jobs)
         run_sharded_downsample(level_jobs, read_job, write_job, f,
                                devices=devices, io_threads=threads,
-                               label=f"resave s{lvl} block", multihost=False)
+                               label=f"resave s{lvl} block", multihost=False,
+                               prefetch_job=prefetch_job)
         stats.pyramid_blocks += len(level_jobs)
         barrier(f"resave-s{lvl}")  # next level reads this level's chunks
 

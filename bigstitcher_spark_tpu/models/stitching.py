@@ -290,6 +290,39 @@ def _extract_pair_job(sd, loader, ga, gb, overlap, params) -> _PairJob | None:
                     models_a=models_a, models_b=models_b)
 
 
+def _pair_crop_boxes(sd, loader, ga, gb, overlap, params):
+    """``(ds, offset, shape)`` source boxes of the equal-linear crop reads in
+    ``_extract_pair_job`` — the async prefetcher feed (io/prefetch.py).
+    Mirrors the level/mipmap/p0 arithmetic exactly so the prefetched chunks
+    are the ones the extract loop decodes; empty for the non-equal
+    (virtually rendered) path."""
+    models_a = [sd.model(v) for v in ga.views]
+    models_b = [sd.model(v) for v in gb.views]
+    if not _equal_linear(models_a + models_b):
+        return []
+    all_views = list(ga.views) + list(gb.views)
+    common = _pick_common_level(loader, all_views, params.downsampling)
+    if common is None:
+        levels, f = {v: 0 for v in all_views}, (1, 1, 1)
+    else:
+        levels, f = common
+    mip = loader.mipmap_transform(ga.views[0].setup, levels[ga.views[0]])
+    lvl_shape = tuple(
+        int(np.ceil(overlap.shape[d] / f[d])) for d in range(3)
+    )
+    boxes = []
+    for group, models in ((ga, models_a), (gb, models_b)):
+        for v, m in zip(group.views, models):
+            inv = invert_affine(concatenate(m, mip))
+            p0v = np.round(inv[:, :3] @ np.array(overlap.min, np.float64)
+                           + inv[:, 3]).astype(np.int64)
+            b = loader.prefetch_box(v, levels[v],
+                                    tuple(int(o) for o in p0v), lvl_shape)
+            if b is not None:
+                boxes.append(b)
+    return boxes
+
+
 def _fft_shape(shape: Sequence[int]) -> tuple[int, ...]:
     """Next power of two per axis (TPU FFTs are fastest/most accurate at
     powers of two; wrap ambiguity is resolved by the host correlation
@@ -315,6 +348,17 @@ def stitch_all_pairs(
     observe.log(f"stitching: {len(groups)} groups, {len(pairs)} overlapping "
                 "pairs", stage="stitching", echo=progress,
                 groups=len(groups), pairs=len(pairs))
+
+    from ..io import prefetch as _prefetch
+
+    if _prefetch.enabled():
+        # warm the chunk LRU ahead of the serial extract loop below: each
+        # pair's crop reads are known now, so the read-ahead pool overlaps
+        # remote fetches with the per-pair decode + aggregate work
+        for ga, gb, ov in pairs:
+            _prefetch.submit(
+                lambda a=ga, b=gb, o=ov:
+                _pair_crop_boxes(sd, loader, a, b, o, params))
 
     jobs: list[_PairJob] = []
     for ga, gb, ov in pairs:

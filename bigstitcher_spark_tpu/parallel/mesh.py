@@ -311,6 +311,7 @@ def run_sharded_batches(
     workspace_mult: float = 2.0,
     device_drain: bool = False,
     device_consume=None,
+    prefetch_boxes=None,
 ):
     """The shared multi-device work loop: every sharded stage driver (fusion,
     detection, nonrigid, downsample) is this pattern — the TPU replacement of
@@ -366,7 +367,15 @@ def run_sharded_batches(
     never fetched and ``consume`` never runs for it (the streaming
     handoff publish path: the row stays in HBM for the downstream
     stage). Rows it declines are fetched lazily, so a batch it fully
-    claims does zero D2H."""
+    claims does zero D2H.
+
+    ``prefetch_boxes(item) -> [(dataset, offset, shape), ...]`` names the
+    source boxes ``build(item)`` will read. When the async prefetcher is
+    enabled (io/prefetch.py) the loop feeds it batches ahead of the build
+    frontier — roughly batch k+2's boxes while batch k runs — so remote
+    chunk fetches overlap device compute instead of serializing inside
+    ``build``. Purely advisory: with the prefetcher off (the knobs' zero
+    defaults) nothing is enqueued and no code path changes."""
     from .retry import run_with_retry
 
     if multihost:
@@ -388,6 +397,24 @@ def run_sharded_batches(
         drain_pool = CtxThreadPool(max_workers=max(1, n_dev),
                                    thread_name_prefix="bst-dev-drain")
     window = InflightWindow()
+
+    fed = [0]  # batches [0, fed) already submitted to the async prefetcher
+
+    def feed_prefetch(upto: int) -> None:
+        if prefetch_boxes is None:
+            return
+        from ..io import prefetch as _prefetch
+
+        if not _prefetch.enabled():
+            return
+        upto = min(upto, len(batches))
+        while fed[0] < upto:
+            b = batches[fed[0]]
+            fed[0] += 1
+            _prefetch.submit(lambda b=b: [box for it in b
+                                          for box in prefetch_boxes(it)])
+
+    feed_prefetch(2)
     prefetched = {0: [pool.submit(build, it) for it in batches[0]]}
     dispatched: dict[int, tuple] = {}   # bi -> (outs, charged bytes)
     completed: set[int] = set()
@@ -499,6 +526,9 @@ def run_sharded_batches(
         # fetch below only waits on THIS batch's buffers — a data
         # dependency)
         dispatch_ahead(bi)
+        # read-ahead stays two batches past the build frontier (which
+        # dispatch_ahead just advanced to ~bi+2)
+        feed_prefetch(bi + 4)
         keep = list(range(len(batch)))
         try:
             if drain_pool is not None:

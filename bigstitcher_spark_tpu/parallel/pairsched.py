@@ -370,6 +370,7 @@ def run_pair_tasks(
     stage: str = "pairs",
     budget_bytes: int | None = None,
     multihost: bool | None = None,
+    prefetch_boxes=None,
 ) -> list:
     """Run pair tasks across the execution world; results in task-index
     order.
@@ -398,7 +399,13 @@ def run_pair_tasks(
     the default whenever ``jax.process_count() > 1``
     (:func:`multihost_active`, knob ``BST_PAIR_MULTIHOST``); pass
     ``multihost=False`` to pin a call to every-rank-computes-everything,
-    or ``True`` to split even when the knob says 0."""
+    or ``True`` to split even when the knob says 0.
+
+    ``prefetch_boxes(task) -> [(dataset, offset, shape), ...]`` names the
+    source crops ``dispatch(task)`` will read; when the async prefetcher
+    (io/prefetch.py) is enabled this process's local queue is fed to it
+    up front — its byte budget paces how far ahead of dispatch order the
+    remote fetches actually run. Advisory only; off by default."""
     tasks = list(tasks)
     n_slots = max((t.index for t in tasks), default=-1) + 1
     covered = {t.index for t in tasks}
@@ -415,13 +422,14 @@ def run_pair_tasks(
         results: list = [None] * n_slots
         try:
             results = _run_local(local, dispatch, drain, devices,
-                                 n_devices, stage, budget_bytes, n_slots)
+                                 n_devices, stage, budget_bytes, n_slots,
+                                 prefetch_boxes)
         except BaseException as e:  # noqa: BLE001 - reported into gather
             err = e
         results = _merge_multihost(stage, results, err, pi, pc)
     else:
         results = _run_local(tasks, dispatch, drain, devices, n_devices,
-                             stage, budget_bytes, n_slots)
+                             stage, budget_bytes, n_slots, prefetch_boxes)
     missing = [i for i, r in enumerate(results)
                if r is None and i in covered]
     if missing:
@@ -429,6 +437,21 @@ def run_pair_tasks(
             f"{stage}: {len(missing)} pair task(s) produced no result "
             f"(indices {missing[:8]}...)")
     return [None if r is None else r[1] for r in results]
+
+
+def _feed_pair_prefetch(tasks, prefetch_boxes) -> None:
+    """Submit every queued task's source crops to the async prefetcher
+    (io/prefetch.py) before the device workers start: box enumeration
+    runs on the prefetch workers and the prefetch byte budget paces how
+    far ahead of dispatch order the remote fetches actually get."""
+    if prefetch_boxes is None:
+        return
+    from ..io import prefetch as _prefetch
+
+    if not _prefetch.enabled():
+        return
+    for t in tasks:
+        _prefetch.submit(lambda t=t: prefetch_boxes(t))
 
 
 def _run_local(
@@ -440,12 +463,14 @@ def _run_local(
     stage: str,
     budget_bytes: int | None,
     n_slots: int,
+    prefetch_boxes=None,
 ) -> list:
     """This process's share of a pair run over its local devices; returns
     the raw slot list (``(True, value)`` at completed indices, ``None``
     elsewhere) for :func:`run_pair_tasks` to merge/unwrap."""
     if not tasks:
         return [None] * n_slots
+    _feed_pair_prefetch(tasks, prefetch_boxes)
     devs = pair_devices(n_devices, devices)
     n_dev = len(devs)
     results: list = [None] * n_slots

@@ -872,6 +872,14 @@ class Daemon:
             if router is not None:
                 router.register(job.id, os.path.join(job.telemetry_dir,
                                                      "output.log"))
+            # new remote-cache coherence window: chunks cached from remote
+            # object stores (BST_REMOTE_CACHE=run) are pinned to one run —
+            # another writer may have touched the bucket between jobs, so
+            # each job re-validates via fresh metadata signatures. Local
+            # stores keep their mtime-keyed warmth across jobs.
+            from ..io.chunkstore import bump_remote_pin
+
+            bump_remote_pin()
             with config.overrides(self._job_budget_overrides(job)), \
                     _cancel.scope(job.token), jobrun:
                 # the stall clock starts NOW: a job that never emits a

@@ -370,6 +370,100 @@ def _rule_xhost_backpressure(rec, flat, trace_rep, wall):
                   "wall_seconds": round(wall or 0.0, 2)})
 
 
+_MIN_REMOTE_READ_BYTES = 64 << 20   # remote bytes before stall advice fires
+_REMOTE_READ_DOMINANCE = 0.5        # remote vs total read bytes = "remote run"
+_MIN_DISKTIER_SPILL_BYTES = 64 << 20  # spilled bytes before thrash advice
+_DISKTIER_SPILL_RATIO = 2.0         # spill vs hit bytes that means write-only
+
+
+def _rule_remote_read_stall(rec, flat, trace_rep, wall):
+    """Remote-dominated reads with the prefetcher off or miss-heavy: the
+    read path paid object-store latency synchronously when the drivers
+    already announce upcoming boxes — a byte-budgeted read-ahead pool
+    (BST_PREFETCH_BYTES, io/prefetch.py) overlaps those fetches with
+    compute."""
+    remote = _sum(flat, "bst_io_remote_read_bytes_total")
+    if remote < _MIN_REMOTE_READ_BYTES:
+        return None
+    total = _sum(flat, "bst_io_read_bytes_total")
+    if total > 0 and remote < _REMOTE_READ_DOMINANCE * total:
+        return None
+    hits = _sum(flat, "bst_io_prefetch_hit_total")
+    misses = _sum(flat, "bst_io_prefetch_miss_total")
+    fetched = _sum(flat, "bst_io_prefetch_bytes_total")
+    budget, src = _recorded_budget(rec, "BST_PREFETCH_BYTES")
+    if fetched <= 0 and hits + misses <= 0:
+        # prefetcher never ran: off (budget 0) or starved of feeds
+        cur = budget or 0
+        return Diagnosis(
+            rule="remote_read_stall",
+            detail=(f"{int(remote)} bytes read synchronously from a "
+                    f"remote object store with the async prefetcher idle "
+                    f"— a nonzero BST_PREFETCH_BYTES read-ahead budget "
+                    f"overlaps those fetches with compute instead of "
+                    f"paying object-store latency per block"),
+            confidence=0.7,
+            knob="BST_PREFETCH_BYTES",
+            suggested_value=str(_clamped_double("BST_PREFETCH_BYTES", cur)),
+            evidence={"remote_read_bytes": int(remote),
+                      "read_bytes_total": int(total),
+                      "prefetch_bytes": int(fetched),
+                      "budget_source": src})
+    lookups = hits + misses
+    if lookups < _MIN_CACHE_OPS:
+        return None
+    ratio = hits / lookups
+    if ratio >= 0.5:
+        return None
+    return Diagnosis(
+        rule="remote_read_stall",
+        detail=(f"prefetcher ran miss-heavy on a remote-read-dominated "
+                f"run: only {ratio:.0%} of {int(lookups)} tracked chunks "
+                f"were consumed before aging out of the "
+                f"{int(budget) if budget else 0}-byte read-ahead window "
+                f"({src}) — a larger BST_PREFETCH_BYTES keeps announced "
+                f"boxes resident until their consumer arrives"),
+        confidence=round(min(0.9, 0.4 + (0.5 - ratio)), 2),
+        knob="BST_PREFETCH_BYTES",
+        suggested_value=str(_clamped_double("BST_PREFETCH_BYTES", budget)),
+        evidence={"remote_read_bytes": int(remote),
+                  "prefetch_hits": int(hits),
+                  "prefetch_misses": int(misses),
+                  "hit_ratio": round(ratio, 3),
+                  "budget_source": src})
+
+
+def _rule_disk_tier_thrash(rec, flat, trace_rep, wall):
+    """NVMe spill tier writing far more than it serves back: evicted
+    chunks cycle through the tier without being re-read before falling
+    off its LRU end — disk bandwidth spent for no hit traffic. A larger
+    BST_DISK_TIER_BYTES keeps the spilled working set resident long
+    enough to be promoted."""
+    spill = _sum(flat, "bst_io_disktier_spill_bytes_total")
+    if spill < _MIN_DISKTIER_SPILL_BYTES:
+        return None
+    hit = _sum(flat, "bst_io_disktier_hit_bytes_total")
+    if spill < _DISKTIER_SPILL_RATIO * max(hit, 1.0):
+        return None
+    evict = _sum(flat, "bst_io_disktier_evict_bytes_total")
+    budget, src = _recorded_budget(rec, "BST_DISK_TIER_BYTES")
+    return Diagnosis(
+        rule="disk_tier_thrash",
+        detail=(f"disk tier spilled {int(spill)} bytes but served only "
+                f"{int(hit)} back ({int(evict)} evicted unread) under "
+                f"the {int(budget) if budget else 0}-byte budget ({src}) "
+                f"— chunks age out before their re-read; a larger "
+                f"BST_DISK_TIER_BYTES stops the write-only churn"),
+        confidence=round(min(0.9, 0.4 + min(0.5, spill
+                                            / max(hit + spill, 1.0))), 2),
+        knob="BST_DISK_TIER_BYTES",
+        suggested_value=str(_clamped_double("BST_DISK_TIER_BYTES", budget)),
+        evidence={"spill_bytes": int(spill), "hit_bytes": int(hit),
+                  "evict_bytes": int(evict),
+                  "budget_bytes": int(budget or 0),
+                  "budget_source": src})
+
+
 def _rule_relay_drops(rec, flat, trace_rep, wall):
     drops = _sum(flat, "bst_relay_dropped_total")
     sent = _sum(flat, "bst_relay_sent_total")
@@ -393,6 +487,7 @@ _RULES = (_rule_low_overlap, _rule_cold_buckets, _rule_chunk_cache,
           _rule_tile_cache, _rule_inflight_saturated,
           _rule_dag_backpressure, _rule_dag_handoff_miss,
           _rule_multihost_pair_imbalance, _rule_xhost_backpressure,
+          _rule_remote_read_stall, _rule_disk_tier_thrash,
           _rule_relay_drops)
 
 

@@ -1,0 +1,192 @@
+"""Minimal in-process S3-protocol server (GET/PUT/HEAD/DELETE +
+ListObjectsV2 + Range reads) for driving tensorstore's REAL s3 kvstore
+driver end-to-end without network egress — the role the reference fills
+with actual S3 (cloud/TestCloudFunctions.java:42-181).
+
+Auth headers (SigV4) are accepted and ignored; objects live in a dict.
+Promoted from the test tree so the bench's ``measure_cloud`` extra and
+the cloud smoke script share one fixture with the test suite
+(tests/s3_fake.py is a re-export shim).
+
+Fault/latency injection for tiered-IO experiments:
+
+- ``latency_s``: per-request sleep — a dialable stand-in for
+  object-store round-trip time, what makes prefetch overlap measurable
+  on localhost.
+- ``fail_puts``: fail the next N PUT requests with HTTP 500 — drives
+  the multipart upload retry path (parallel.retry) without network
+  flakes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+from xml.sax.saxutils import escape
+
+
+class S3FakeServer:
+    def __init__(self, latency_s: float = 0.0):
+        self.objects: dict[str, bytes] = {}
+        self.lock = threading.Lock()
+        self.requests: list[str] = []  # method + path log (assertable)
+        self.latency_s = float(latency_s)
+        self.fail_puts = 0             # next N PUTs answer HTTP 500
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _key(self):
+                # path: /<bucket>/<key>  (path-style addressing)
+                parts = unquote(urlparse(self.path).path).lstrip("/")
+                return parts.split("/", 1)[1] if "/" in parts else ""
+
+            def _respond(self, code, body=b"", headers=None):
+                self.send_response(code)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _not_found(self):
+                body = (b'<?xml version="1.0"?><Error><Code>NoSuchKey'
+                        b"</Code><Message>absent</Message></Error>")
+                self._respond(404, body,
+                              {"Content-Type": "application/xml"})
+
+            def _lag(self):
+                if server.latency_s > 0:
+                    time.sleep(server.latency_s)
+
+            def do_GET(self):
+                server.requests.append(f"GET {self.path}")
+                self._lag()
+                q = parse_qs(urlparse(self.path).query)
+                if "list-type" in q:
+                    return self._list(q)
+                key = self._key()
+                with server.lock:
+                    data = server.objects.get(key)
+                if data is None:
+                    return self._not_found()
+                etag = hashlib.md5(data).hexdigest()
+                rng = self.headers.get("Range")
+                if rng and rng.startswith("bytes="):
+                    lo_s, _, hi_s = rng[6:].partition("-")
+                    lo = int(lo_s) if lo_s else 0
+                    hi = int(hi_s) if hi_s else len(data) - 1
+                    hi = min(hi, len(data) - 1)
+                    part = data[lo:hi + 1]
+                    return self._respond(206, part, {
+                        "ETag": f'"{etag}"',
+                        "Content-Range":
+                            f"bytes {lo}-{hi}/{len(data)}",
+                        "Content-Type": "application/octet-stream"})
+                self._respond(200, data, {
+                    "ETag": f'"{etag}"',
+                    "Content-Type": "application/octet-stream"})
+
+            def _list(self, q):
+                prefix = q.get("prefix", [""])[0]
+                start_after = q.get("start-after", [""])[0]
+                token = q.get("continuation-token", [""])[0]
+                with server.lock:
+                    keys = sorted(k for k in server.objects
+                                  if k.startswith(prefix)
+                                  and k > max(start_after, token))
+                max_keys = int(q.get("max-keys", ["1000"])[0])
+                page, rest = keys[:max_keys], keys[max_keys:]
+                parts = ['<?xml version="1.0" encoding="UTF-8"?>',
+                         "<ListBucketResult>",
+                         f"<KeyCount>{len(page)}</KeyCount>",
+                         f"<IsTruncated>{'true' if rest else 'false'}"
+                         "</IsTruncated>"]
+                if rest:
+                    parts.append("<NextContinuationToken>"
+                                 f"{escape(page[-1])}"
+                                 "</NextContinuationToken>")
+                with server.lock:
+                    for k in page:
+                        parts.append(
+                            f"<Contents><Key>{escape(k)}</Key>"
+                            f"<Size>{len(server.objects[k])}</Size>"
+                            "</Contents>")
+                parts.append("</ListBucketResult>")
+                self._respond(200, "".join(parts).encode(),
+                              {"Content-Type": "application/xml"})
+
+            def do_HEAD(self):
+                server.requests.append(f"HEAD {self.path}")
+                self._lag()
+                key = self._key()
+                if not key:  # HeadBucket
+                    return self._respond(200)
+                with server.lock:
+                    data = server.objects.get(key)
+                if data is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                etag = hashlib.md5(data).hexdigest()
+                self.send_response(200)
+                self.send_header("ETag", f'"{etag}"')
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+
+            def do_PUT(self):
+                server.requests.append(f"PUT {self.path}")
+                self._lag()
+                n = int(self.headers.get("Content-Length", 0))
+                data = self.rfile.read(n)
+                with server.lock:
+                    if server.fail_puts > 0:
+                        server.fail_puts -= 1
+                        body = (b'<?xml version="1.0"?><Error><Code>'
+                                b"InternalError</Code><Message>injected"
+                                b"</Message></Error>")
+                        return self._respond(
+                            500, body,
+                            {"Content-Type": "application/xml"})
+                    server.objects[self._key()] = data
+                etag = hashlib.md5(data).hexdigest()
+                self._respond(200, b"", {"ETag": f'"{etag}"'})
+
+            def do_DELETE(self):
+                server.requests.append(f"DELETE {self.path}")
+                self._lag()
+                key = self._key()
+                with server.lock:
+                    server.objects.pop(key, None)
+                self._respond(204)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+
+    def start(self):
+        self.thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def remote_request_count(self, method: str | None = None) -> int:
+        """Requests seen so far, optionally one HTTP method's — the
+        warm-rerun "zero remote rereads" assertion reads the delta."""
+        with self.lock:
+            if method is None:
+                return len(self.requests)
+            return sum(1 for r in self.requests
+                       if r.startswith(method + " "))

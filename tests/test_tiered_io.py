@@ -1,0 +1,321 @@
+"""Tiered storage IO engine (PR 19): async prefetch overlap, LRU→disk
+spill/promote, budget-0 inertness (the exact pre-tier code paths),
+generation-bump invalidation reaching the disk tier, and
+multipart-parallel uploads surviving injected transient failures without
+a partial chunk."""
+
+import numpy as np
+import pytest
+
+from bigstitcher_spark_tpu import profiling
+from bigstitcher_spark_tpu.io import chunkcache, disktier, prefetch
+from bigstitcher_spark_tpu.io.chunkstore import ChunkStore, StorageFormat
+from bigstitcher_spark_tpu.observe import metrics
+
+CHUNK = (16, 16, 8)          # chunk bytes: 16*16*8 * 2 = 4096
+CHUNK_BYTES = 16 * 16 * 8 * 2
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tiers(monkeypatch):
+    monkeypatch.setenv("BST_CHUNK_CACHE_BYTES", str(64 << 20))
+    prefetch.reset()
+    chunkcache.get_cache().clear()
+    disktier.get_tier().clear()
+    yield
+    prefetch.reset()
+    prefetch.drain(5.0)
+    chunkcache.get_cache().clear()
+    disktier.get_tier().clear()
+
+
+def _delta(baseline, prefix):
+    d = metrics.get_registry().snapshot_delta(baseline)
+    return {k.replace(prefix, ""): int(v) for k, v in d.items()
+            if k.startswith(prefix) and isinstance(v, (int, float))}
+
+
+def _make_n5(tmp_path, name="c", shape=(64, 64, 8)):
+    store = ChunkStore.create(str(tmp_path / f"{name}.n5"), StorageFormat.N5)
+    ds = store.create_dataset("a", shape, CHUNK, "uint16")
+    data = (np.arange(int(np.prod(shape))).reshape(shape)
+            % 60000).astype(np.uint16)
+    ds.write(data, (0, 0, 0))
+    chunkcache.get_cache().clear()   # drop anything staged by the write
+    disktier.get_tier().clear()
+    return store, ds, data
+
+
+class TestPrefetchOverlap:
+    """Submitted boxes decode on worker threads into the shared LRU, and
+    the consumer's later read is pure cache hits — trace-asserted via the
+    io.prefetch span and the read-path byte attribution."""
+
+    def test_prefetch_then_read_hits_cache(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BST_PREFETCH_BYTES", str(64 << 20))
+        monkeypatch.setenv("BST_PREFETCH_THREADS", "2")
+        _, ds, data = _make_n5(tmp_path)
+
+        profiling.enable(True)
+        profiling.get().reset()
+        base = metrics.get_registry().snapshot()
+        try:
+            prefetch.submit_boxes([(ds, (0, 0, 0), (32, 32, 8))])
+            assert prefetch.drain(15.0), "prefetch pool failed to drain"
+            spans = profiling.get().stats()
+        finally:
+            profiling.enable(False)
+            profiling.get().reset()
+
+        # the fetch ran off the consumer path, under its own span, and
+        # attributed its own traffic to the prefetch byte counter
+        assert "io.prefetch" in spans
+        d = _delta(base, "bst_io_prefetch_")
+        assert d["bytes_total"] == 4 * CHUNK_BYTES
+        st = prefetch.stats()
+        assert st["tracked_entries"] == 4
+
+        base = metrics.get_registry().snapshot()
+        got = ds.read((0, 0, 0), (32, 32, 8))
+        assert np.array_equal(got, data[:32, :32])
+        cc = _delta(base, "bst_chunk_cache_")
+        assert cc["hits_total"] == 4 and cc.get("misses_total", 0) == 0
+        pf = _delta(base, "bst_io_prefetch_")
+        # consumption hook: every prefetched chunk was credited as a hit
+        assert pf["hit_total"] == 4
+        assert pf["hit_bytes_total"] == 4 * CHUNK_BYTES
+        # nothing re-decoded from the container on the consumer's read
+        io = metrics.get_registry().snapshot_delta(base)
+        assert not io.get('bst_io_read_bytes_total{path="native"}')
+        assert not io.get('bst_io_read_bytes_total{path="tensorstore"}')
+        assert io.get('bst_io_read_bytes_total{path="cache"}') == \
+            4 * CHUNK_BYTES
+
+    def test_budget_pacing_untracks_oldest_as_misses(self, tmp_path,
+                                                     monkeypatch):
+        # budget of 2 chunks, prefetch 4: the pool must untrack the
+        # oldest overshoot as wasted read-ahead, not wedge
+        monkeypatch.setenv("BST_PREFETCH_BYTES", str(2 * CHUNK_BYTES))
+        monkeypatch.setenv("BST_PREFETCH_THREADS", "2")
+        _, ds, _ = _make_n5(tmp_path)
+        base = metrics.get_registry().snapshot()
+        prefetch.submit_boxes([(ds, (0, 0, 0), (32, 32, 8))])
+        assert prefetch.drain(15.0)
+        d = _delta(base, "bst_io_prefetch_")
+        assert d["miss_total"] >= 2           # overshoot counted as waste
+        assert prefetch.stats()["tracked_bytes"] <= 2 * CHUNK_BYTES
+
+
+class TestDiskSpillPromote:
+    def test_spill_then_promote_bit_identical(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BST_CHUNK_CACHE_BYTES", str(3 * CHUNK_BYTES))
+        monkeypatch.setenv("BST_DISK_TIER_BYTES", str(64 << 20))
+        monkeypatch.setenv("BST_DISK_TIER_DIR", str(tmp_path / "tier"))
+        _, ds, data = _make_n5(tmp_path)
+
+        base = metrics.get_registry().snapshot()
+        got = ds.read((0, 0, 0), (64, 64, 8))      # 16 chunks, 3-chunk LRU
+        assert np.array_equal(got, data)
+        d = _delta(base, "bst_io_disktier_")
+        assert d["spill_bytes_total"] >= 13 * CHUNK_BYTES
+        st = disktier.get_tier().stats()
+        assert st["entries"] >= 13
+
+        # second pass is served from memory + disk: bit-identical, zero
+        # container re-decode
+        base = metrics.get_registry().snapshot()
+        got = ds.read((0, 0, 0), (64, 64, 8))
+        assert np.array_equal(got, data)
+        d = metrics.get_registry().snapshot_delta(base)
+        assert not d.get('bst_io_read_bytes_total{path="native"}')
+        assert not d.get('bst_io_read_bytes_total{path="tensorstore"}')
+        assert _delta(base, "bst_io_disktier_")["hit_bytes_total"] > 0
+
+    def test_tier_is_inclusive_promote_leaves_disk_copy(self, tmp_path,
+                                                        monkeypatch):
+        monkeypatch.setenv("BST_CHUNK_CACHE_BYTES", str(CHUNK_BYTES))
+        monkeypatch.setenv("BST_DISK_TIER_BYTES", str(64 << 20))
+        monkeypatch.setenv("BST_DISK_TIER_DIR", str(tmp_path / "tier"))
+        _, ds, data = _make_n5(tmp_path, shape=(32, 16, 8))   # 2 chunks
+        ds.read((0, 0, 0), (32, 16, 8))            # chunk 0 spills
+        tier = disktier.get_tier()
+        assert tier.stats()["entries"] == 1
+
+        # promote chunk 0 back (evicts chunk 1); the disk copy must stay —
+        # a write invalidates both tiers, so it is still current
+        got = ds.read((0, 0, 0), (16, 16, 8))
+        assert np.array_equal(got, data[:16, :16])
+        assert tier.stats()["entries"] >= 1
+
+        # bounce back and forth: every read stays bit-identical and the
+        # re-evicted promoted chunk skips the rewrite (spill bytes flat)
+        base = metrics.get_registry().snapshot()
+        for _ in range(3):
+            assert np.array_equal(ds.read((16, 0, 0), (16, 16, 8)),
+                                  data[16:32, :16])
+            assert np.array_equal(ds.read((0, 0, 0), (16, 16, 8)),
+                                  data[:16, :16])
+        d = metrics.get_registry().snapshot_delta(base)
+        assert not d.get('bst_io_read_bytes_total{path="native"}')
+        assert not d.get('bst_io_read_bytes_total{path="tensorstore"}')
+        assert not d.get("bst_io_disktier_spill_bytes_total")
+
+    def test_disk_budget_evicts_oldest(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BST_CHUNK_CACHE_BYTES", str(CHUNK_BYTES))
+        monkeypatch.setenv("BST_DISK_TIER_BYTES", str(2 * CHUNK_BYTES))
+        monkeypatch.setenv("BST_DISK_TIER_DIR", str(tmp_path / "tier"))
+        _, ds, data = _make_n5(tmp_path)
+        base = metrics.get_registry().snapshot()
+        got = ds.read((0, 0, 0), (64, 64, 8))      # 16 chunks through a
+        assert np.array_equal(got, data)           # 2-chunk disk budget
+        st = disktier.get_tier().stats()
+        assert st["entries"] <= 2 and st["bytes"] <= 2 * CHUNK_BYTES
+        assert _delta(base, "bst_io_disktier_")["evict_bytes_total"] > 0
+
+
+class TestBudgetZeroInertness:
+    """BST_PREFETCH_BYTES=0 / BST_DISK_TIER_BYTES=0 / BST_REMOTE_CACHE=off
+    must restore the exact pre-tier code paths."""
+
+    def test_prefetch_zero_budget_is_a_noop(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BST_PREFETCH_BYTES", "0")
+        _, ds, _ = _make_n5(tmp_path)
+        base = metrics.get_registry().snapshot()
+        prefetch.submit_boxes([(ds, (0, 0, 0), (64, 64, 8))])
+        st = prefetch.stats()
+        assert st["queued"] == 0 and st["tracked_entries"] == 0
+        assert not any(_delta(base, "bst_io_prefetch_").values())
+
+    def test_disk_tier_zero_budget_never_spills(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BST_CHUNK_CACHE_BYTES", str(2 * CHUNK_BYTES))
+        monkeypatch.setenv("BST_DISK_TIER_BYTES", "0")
+        monkeypatch.setenv("BST_DISK_TIER_DIR", str(tmp_path / "tier"))
+        _, ds, data = _make_n5(tmp_path)
+        assert np.array_equal(ds.read((0, 0, 0), (64, 64, 8)), data)
+        assert disktier.get_tier().stats()["entries"] == 0
+        assert not (tmp_path / "tier").exists()
+        # evicted chunks really are gone: the re-read decodes again
+        base = metrics.get_registry().snapshot()
+        ds.read((0, 0, 0), (16, 16, 8))
+        assert _delta(base, "bst_chunk_cache_")["misses_total"] == 1
+
+    def test_remote_cache_off_restores_bypass(self, tmp_path, monkeypatch):
+        _, ds, _ = _make_n5(tmp_path)
+        assert ds._cacheable()                     # local: always eligible
+        # make the same dataset look like a remote object store
+        monkeypatch.setattr(ds.store, "is_local", False)
+        monkeypatch.setattr(ds.store, "is_remote_object", True, raising=False)
+        monkeypatch.setenv("BST_REMOTE_CACHE", "run")
+        assert ds._cacheable()
+        monkeypatch.setenv("BST_REMOTE_CACHE", "off")
+        assert not ds._cacheable()                 # historical bypass
+        assert ds.prefetch_box((0, 0, 0), (16, 16, 8)) == []
+
+
+class TestInvalidationThroughDisk:
+    def test_write_invalidates_spilled_chunks(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BST_CHUNK_CACHE_BYTES", str(2 * CHUNK_BYTES))
+        monkeypatch.setenv("BST_DISK_TIER_BYTES", str(64 << 20))
+        monkeypatch.setenv("BST_DISK_TIER_DIR", str(tmp_path / "tier"))
+        _, ds, data = _make_n5(tmp_path)
+        assert np.array_equal(ds.read((0, 0, 0), (64, 64, 8)), data)
+        tier = disktier.get_tier()
+        assert tier.stats()["entries"] >= 14       # most chunks on disk
+
+        patch = np.full(CHUNK, 7, np.uint16)
+        ds.write(patch, (0, 0, 0))                 # bumps the generation
+        expect = data.copy()
+        expect[:16, :16, :8] = patch
+
+        # a stale disk entry for chunk (0,0,0) would serve the OLD bytes
+        got = ds.read((0, 0, 0), (64, 64, 8))
+        assert np.array_equal(got, expect)
+        assert (got[:16, :16, :8] == 7).all()
+
+
+class TestMultipartUpload:
+    @pytest.fixture()
+    def s3(self, monkeypatch):
+        import os as _os
+        import sys as _sys
+
+        from bigstitcher_spark_tpu.io import uris
+
+        sys_path_added = False
+        try:
+            from s3_fake import S3FakeServer
+        except ImportError:
+            _sys.path.insert(0, _os.path.dirname(__file__))
+            sys_path_added = True
+            from s3_fake import S3FakeServer
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "test")
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "testsecret")
+        srv = S3FakeServer().start()
+        uris.set_s3_endpoint(srv.endpoint)
+        uris.set_s3_region("us-east-1")
+        yield srv
+        uris.set_s3_endpoint(None)
+        uris.set_s3_region(None)
+        srv.stop()
+        if sys_path_added:
+            _sys.path.pop(0)
+
+    def test_retry_on_injected_failure_no_partial_chunk(self, tmp_path, s3,
+                                                        monkeypatch):
+        from bigstitcher_spark_tpu.io import chunkstore
+
+        monkeypatch.setenv("BST_UPLOAD_THREADS", "8")
+        store = ChunkStore.create("s3://upbkt/c.n5", StorageFormat.N5)
+        ds = store.create_dataset("a", (64, 64, 8), CHUNK, "uint16")
+        data = (np.arange(64 * 64 * 8).reshape(64, 64, 8)
+                % 60000).astype(np.uint16)
+
+        calls = {"n": 0, "failed": 0}
+        real_upload = chunkstore._upload_one
+
+        def flaky_upload(dset, sel, part):
+            calls["n"] += 1
+            if calls["failed"] < 2:
+                calls["failed"] += 1
+                raise OSError("injected transient upload failure")
+            real_upload(dset, sel, part)
+
+        monkeypatch.setattr(chunkstore, "_upload_one", flaky_upload)
+        profiling.enable(True)
+        profiling.get().reset()
+        base = metrics.get_registry().snapshot()
+        try:
+            ds.write(data, (0, 0, 0))              # 16 parts, 2 injected
+            spans = profiling.get().stats()        # failures, retried
+        finally:
+            profiling.enable(False)
+            profiling.get().reset()
+
+        assert calls["failed"] == 2
+        assert calls["n"] == 16 + 2                # every part + 2 retries
+        assert "io.upload" in spans
+        d = metrics.get_registry().snapshot_delta(base)
+        assert d.get("bst_io_remote_write_bytes_total", 0) >= data.nbytes
+
+        # read back THROUGH the s3 driver, bypassing the decoded cache:
+        # every chunk is complete and bit-identical (no partial part)
+        chunkcache.get_cache().clear()
+        monkeypatch.setenv("BST_REMOTE_CACHE", "off")
+        assert np.array_equal(ds.read_full(), data)
+
+    def test_single_thread_keeps_one_ts_write(self, tmp_path, s3,
+                                              monkeypatch):
+        from bigstitcher_spark_tpu.io import chunkstore
+
+        monkeypatch.setenv("BST_UPLOAD_THREADS", "1")
+        store = ChunkStore.create("s3://upbkt2/c.n5", StorageFormat.N5)
+        ds = store.create_dataset("a", (32, 32, 8), CHUNK, "uint16")
+        data = np.ones((32, 32, 8), np.uint16)
+
+        def boom(dset, sel, part):
+            raise AssertionError("multipart path taken with 1 thread")
+
+        monkeypatch.setattr(chunkstore, "_upload_one", boom)
+        ds.write(data, (0, 0, 0))                  # single ts write fallback
+        monkeypatch.setenv("BST_REMOTE_CACHE", "off")
+        assert np.array_equal(ds.read_full(), data)

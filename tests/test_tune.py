@@ -202,6 +202,72 @@ class TestAdvisorRules:
         assert [d.rule for d in diags] == ["relay_drops"]
         assert diags[0].knob == "BST_RELAY_QUEUE"
 
+    def test_remote_read_stall_prefetcher_idle(self):
+        rec = _healthy_record(metrics={
+            "bst_io_remote_read_bytes_total": float(512 << 20),
+            "bst_io_read_bytes_total": float(600 << 20)})
+        diags = tune.advise_record(rec)
+        assert [d.rule for d in diags] == ["remote_read_stall"]
+        d = diags[0]
+        assert d.knob == "BST_PREFETCH_BYTES"
+        assert d.evidence["remote_read_bytes"] == 512 << 20
+        assert int(d.suggested_value) > 0
+
+    def test_remote_read_stall_miss_heavy(self):
+        rec = _healthy_record(metrics={
+            "bst_io_remote_read_bytes_total": float(512 << 20),
+            "bst_io_read_bytes_total": float(600 << 20),
+            "bst_io_prefetch_bytes_total": float(256 << 20),
+            "bst_io_prefetch_hit_total": 20.0,
+            "bst_io_prefetch_miss_total": 80.0})
+        diags = tune.advise_record(rec)
+        assert [d.rule for d in diags] == ["remote_read_stall"]
+        d = diags[0]
+        assert d.knob == "BST_PREFETCH_BYTES"
+        assert d.evidence["hit_ratio"] == 0.2
+        assert int(d.suggested_value) > int(
+            config.get_bytes("BST_PREFETCH_BYTES"))
+
+    def test_remote_read_stall_quiet_when_local_dominated(self):
+        rec = _healthy_record(metrics={
+            "bst_io_remote_read_bytes_total": float(100 << 20),
+            "bst_io_read_bytes_total": float(1 << 30)})
+        assert tune.advise_record(rec) == []
+
+    def test_remote_read_stall_quiet_when_prefetch_hits(self):
+        rec = _healthy_record(metrics={
+            "bst_io_remote_read_bytes_total": float(512 << 20),
+            "bst_io_read_bytes_total": float(600 << 20),
+            "bst_io_prefetch_bytes_total": float(512 << 20),
+            "bst_io_prefetch_hit_total": 90.0,
+            "bst_io_prefetch_miss_total": 10.0})
+        assert tune.advise_record(rec) == []
+
+    def test_disk_tier_thrash(self):
+        rec = _healthy_record(metrics={
+            "bst_io_disktier_spill_bytes_total": float(1 << 30),
+            "bst_io_disktier_hit_bytes_total": float(100 << 20),
+            "bst_io_disktier_evict_bytes_total": float(900 << 20)})
+        diags = tune.advise_record(rec)
+        assert [d.rule for d in diags] == ["disk_tier_thrash"]
+        d = diags[0]
+        assert d.knob == "BST_DISK_TIER_BYTES"
+        assert d.evidence["spill_bytes"] == 1 << 30
+        assert int(d.suggested_value) >= int(
+            config.KNOBS["BST_DISK_TIER_BYTES"].tunable.lo)
+
+    def test_disk_tier_serving_back_is_quiet(self):
+        rec = _healthy_record(metrics={
+            "bst_io_disktier_spill_bytes_total": float(200 << 20),
+            "bst_io_disktier_hit_bytes_total": float(150 << 20)})
+        assert tune.advise_record(rec) == []
+
+    def test_small_disk_tier_spill_is_quiet(self):
+        rec = _healthy_record(metrics={
+            "bst_io_disktier_spill_bytes_total": float(10 << 20),
+            "bst_io_disktier_hit_bytes_total": 0.0})
+        assert tune.advise_record(rec) == []
+
     def test_low_overlap_needs_the_trace(self):
         trace_rep = {"stages": {"fusion": {
             "d2h_s": 2.0, "write_s": 3.0,
