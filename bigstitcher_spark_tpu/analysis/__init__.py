@@ -2,19 +2,24 @@
 
 Machine-checks the conventions the package's correctness rests on —
 no hidden host syncs in device hot paths, lock discipline around shared
-mutable state, all ``BST_*`` knobs read through the config registry,
+mutable state, a cycle-free lock-order graph, no blocking calls under a
+lock, context-carrying thread spawns, cancellable worker loops, clean
+socket teardown, all ``BST_*`` knobs read through the config registry,
 every metric name declared once — as a tier-1 test and a CLI tool.
-Stdlib ``ast`` only; see :mod:`.checks` for the check catalogue and
-:mod:`.linter` for suppressions and the baseline protocol.
+Stdlib ``ast`` only; see :mod:`.checks` and :mod:`.concurrency` for the
+check catalogue and :mod:`.linter` for suppressions and the baseline
+protocol.
 """
 
 from .checks import ALL_CHECKS, Finding
+from .concurrency import build_lock_graph, lock_graph_dot
 from .linter import (
     baseline_counts,
     default_baseline_path,
     default_root,
     load_baseline,
     new_findings,
+    parse_package,
     run_lint,
     save_baseline,
 )
@@ -23,10 +28,13 @@ __all__ = [
     "ALL_CHECKS",
     "Finding",
     "baseline_counts",
+    "build_lock_graph",
     "default_baseline_path",
     "default_root",
     "load_baseline",
+    "lock_graph_dot",
     "new_findings",
+    "parse_package",
     "run_lint",
     "save_baseline",
 ]

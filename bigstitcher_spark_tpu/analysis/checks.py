@@ -1,12 +1,16 @@
-"""The four ``bst lint`` invariant checks (pure stdlib ``ast``).
+"""The core ``bst lint`` invariant checks (pure stdlib ``ast``).
 
 Each check is a function ``(files: list[FileCtx]) -> list[Finding]`` over
-the whole parsed package, so cross-file invariants (lock acquisition
-order, the metric-name registry, the config-knob declarations) see every
+the whole parsed package, so cross-file invariants (the lock-order
+graph, the metric-name registry, the config-knob declarations) see every
 module at once. All checks are approximations by design — they encode
 the conventions the codebase actually follows, and anything cleverer
 than the convention earns a ``# bst-lint: off=<check>`` suppression with
 the reasoning next to it.
+
+The concurrency-discipline suite (lock-order, blocking-under-lock,
+thread-spawn, cancel-coverage, socket-hygiene) lives in
+``analysis/concurrency.py`` and registers into ``ALL_CHECKS`` below.
 
 Checks
 ------
@@ -24,9 +28,9 @@ Checks
     State mutated at least once inside a ``with <lock>:`` block is
     lock-guarded; mutating the same attribute/global outside any lock
     block (outside ``__init__`` and ``*_locked`` helpers, which assume
-    the caller holds it) is a finding. Also flags inconsistent lock
-    ACQUISITION ORDER: two locks nested as A->B in one place and B->A in
-    another is a latent deadlock.
+    the caller holds it) is a finding. Acquisition ORDER is the
+    ``lock-order`` check's job (concurrency.py): it builds the whole
+    interprocedural graph rather than matching single-file pairs.
 
 ``config-registry``
     Bans raw ``os.environ``/``os.getenv`` access to ``BST_*`` names
@@ -369,7 +373,7 @@ def check_host_sync(files: list[FileCtx]) -> list[Finding]:
 
 
 # --------------------------------------------------------------------------
-# lock-discipline (+ acquisition order)
+# lock-discipline
 # --------------------------------------------------------------------------
 
 _MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
@@ -456,10 +460,6 @@ def _module_globals(tree: ast.Module) -> set[str]:
 
 def check_lock_discipline(files: list[FileCtx]) -> list[Finding]:
     out: list[Finding] = []
-    # ordered lock pairs for the cross-file acquisition-order check:
-    # (outer_id, inner_id) -> list of (ctx, node)
-    pairs: dict[tuple[str, str], list] = {}
-
     for ctx in files:
         mglobals = _module_globals(ctx.tree)
         sites: list[_MutSite] = []
@@ -468,10 +468,6 @@ def check_lock_discipline(files: list[FileCtx]) -> list[Finding]:
             exempt = (fn.name in _EXEMPT_FNS
                       or fn.name.endswith("_locked"))
             lock_stack: list[str] = []
-
-            def qual(lock_text: str) -> str:
-                scope = class_name or "<module>"
-                return f"{ctx.relpath}:{scope}:{lock_text}"
 
             def walk(stmts) -> None:
                 for s in stmts:
@@ -483,12 +479,7 @@ def check_lock_discipline(files: list[FileCtx]) -> list[Finding]:
                         lock_texts = [t for t in
                                       (_is_lock_expr(i.context_expr)
                                        for i in s.items) if t]
-                        for t in lock_texts:
-                            if lock_stack:
-                                pairs.setdefault(
-                                    (qual(lock_stack[-1]), qual(t)),
-                                    []).append((ctx, s))
-                            lock_stack.append(t)
+                        lock_stack.extend(lock_texts)
                         walk(s.body)
                         for _ in lock_texts:
                             lock_stack.pop()
@@ -532,24 +523,6 @@ def check_lock_discipline(files: list[FileCtx]) -> list[Finding]:
                     f"{name} is mutated here without the lock that guards "
                     f"it in {g.fn_name}() (line {g.node.lineno}); hold the "
                     f"lock or rename the helper *_locked"))
-
-    seen_orders: dict[frozenset, tuple[str, str]] = {}
-    for (a, b), where in sorted(pairs.items()):
-        pair_key = frozenset((a, b))
-        if a == b:
-            continue
-        prev = seen_orders.get(pair_key)
-        if prev is None:
-            seen_orders[pair_key] = (a, b)
-        elif prev != (a, b):
-            for ctx, node in where:
-                la = a.rsplit(":", 1)[-1]
-                lb = b.rsplit(":", 1)[-1]
-                out.append(ctx.finding(
-                    "lock-discipline", node,
-                    f"inconsistent lock order: {la} -> {lb} here but "
-                    f"{lb} -> {la} elsewhere — pick one global order "
-                    f"(latent deadlock)"))
     return out
 
 
@@ -847,3 +820,6 @@ ALL_CHECKS = {
     "metric-name": check_metric_names,
     "span-name": check_span_names,
 }
+# the concurrency-discipline suite (analysis/concurrency.py) registers
+# its five checks into ALL_CHECKS when imported; the package __init__
+# imports it, so any `analysis.*` import sees the full table

@@ -1,0 +1,883 @@
+"""Concurrency-discipline checks for ``bst lint`` (pure stdlib ``ast``).
+
+The threaded surface (``serve/daemon.py``, ``observe/relay.py``,
+``dag/exchange.py``, ``dag/stream.py``, ``io/prefetch.py``,
+``io/disktier.py``) grew past what review passes can police: the PR 15
+round showed the dominant bug classes are *mechanical* concurrency
+violations — a blocking read torn down by its own send timeout, a
+``close()`` without ``shutdown()``, an unlocked check-then-close. The
+reference's Spark runtime gets this discipline for free from the JVM
+scheduler; our hand-rolled threads, locks and sockets get it from these
+five checks instead. Like every ``bst lint`` check they are
+approximations of the convention — a deliberate exception earns a
+``# bst-lint: off=<check>`` suppression with the reasoning alongside.
+
+Checks
+------
+``lock-order``
+    Whole-package, interprocedural lock-acquisition graph. Locks are
+    identified by their binding — ``self.<attr>`` assigned from
+    ``threading.Lock/RLock/Condition/Semaphore`` (a ``Condition(self.x)``
+    ALIASES to the lock it wraps: the condition and its lock are one
+    node), module globals likewise, plus a name fallback for lock-ish
+    ``with`` targets. An edge A->B is added when a ``with B:`` is
+    reachable inside a ``with A:`` body — directly nested, or one call
+    level deep through a resolvable callee that acquires B. Any cycle in
+    the graph is a potential deadlock (two threads entering the cycle at
+    different nodes can each hold what the other wants). Replaces the
+    old single-file A->B/B->A pair heuristic; debug the computed graph
+    with ``bst lint --graph lock-order``.
+
+``blocking-under-lock``
+    Calls that can block indefinitely (or for seconds) while a lock is
+    held stall every other thread that needs the lock — the relay
+    send-timeout-tears-down-the-reader bug class. Flags, inside a
+    ``with <lock>:`` body: socket ``send*/recv*/accept/connect``,
+    ``readline``, ``queue.Queue.get/put`` without ``block=False`` /
+    ``timeout=`` / ``*_nowait``, ``subprocess.*``, ``time.sleep`` above
+    a small literal threshold, ``jax.device_get`` /
+    ``.block_until_ready()``, and chunk-container reads/writes — plus,
+    one call level deep, same-file helpers that do any of the above.
+    ``Condition.wait`` is exempt: it RELEASES the lock while blocked.
+
+``thread-spawn``
+    Raw ``threading.Thread`` / ``concurrent.futures.ThreadPoolExecutor``
+    outside ``utils/threads.py`` drop the ``config.overrides()``
+    contextvars and the ambient cancel token that ``CtxThreadPool`` /
+    ``ctx_thread`` carry into workers — a worker spawned raw silently
+    runs with the wrong knobs and ignores job cancellation.
+    Process-lived daemon infrastructure that deliberately must NOT pin
+    one job's context (relay, prefetch pool, exchange) carries explicit
+    suppressions with the justification in the comment.
+
+``cancel-coverage``
+    An unbounded ``while True:`` loop in a worker callable under
+    ``models/``, ``parallel/``, ``dag/`` or ``serve/`` must poll
+    cooperative cancellation somewhere in the loop body —
+    ``utils.cancel.check()``, ``.cancelled()``, a stop-event
+    ``.is_set()``/``.wait()``, a stop-flag test, or a bounded
+    ``*_nowait`` drain. A poll-free loop keeps running after its job is
+    cancelled and wedges daemon drain.
+
+``socket-hygiene``
+    ``socket.close()`` without a preceding ``shutdown()`` on the same
+    binding: io-refs held by ``makefile()`` wrappers keep the fd alive
+    past the bare ``close()``, leaving phantom half-open connections the
+    peer never notices (the PR 15 reconnect-flap class). Server sockets
+    (``bind``/``listen``) are exempt — shutdown on a listener is
+    meaningless — as are the blessed teardown helpers
+    (``_shutdown_close`` / ``_close_sock``) and ``utils/`` files.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .checks import ALL_CHECKS, FileCtx, Finding, dotted
+
+# --------------------------------------------------------------------------
+# shared: lock identification
+# --------------------------------------------------------------------------
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_LOCKNAME_RE = re.compile(r"lock|cond|cv|mutex", re.IGNORECASE)
+
+
+@dataclass
+class _LockDecls:
+    """Per-file lock declarations: attr -> canonical attr per class (a
+    ``Condition(self.x)`` aliases to ``x``), plus module-global locks."""
+    class_locks: dict[str, dict[str, str]] = field(default_factory=dict)
+    module_locks: dict[str, str] = field(default_factory=dict)
+
+    def canonical_attr(self, class_name: str, attr: str) -> str:
+        amap = self.class_locks.get(class_name, {})
+        seen = set()
+        while attr in amap and amap[attr] != attr and attr not in seen:
+            seen.add(attr)
+            attr = amap[attr]
+        return attr
+
+
+def _lock_ctor_call(value: ast.AST) -> tuple[str, ast.AST | None] | None:
+    """(ctor name, aliased-lock expr or None) when ``value`` constructs a
+    threading lock/condition; ``Condition(x)`` carries ``x`` through."""
+    if not isinstance(value, ast.Call):
+        return None
+    d = dotted(value.func)
+    if not d:
+        return None
+    last = d.rsplit(".", 1)[-1]
+    if last not in _LOCK_CTORS:
+        return None
+    alias = value.args[0] if (last == "Condition" and value.args) else None
+    return last, alias
+
+
+def _collect_lock_decls(ctx: FileCtx) -> _LockDecls:
+    decls = _LockDecls()
+
+    def record(store: dict[str, str], name: str, alias: ast.AST | None,
+               attr_of_self: bool) -> None:
+        if alias is not None:
+            ad = dotted(alias)
+            if ad and attr_of_self and ad.startswith("self."):
+                store[name] = ad[5:]
+                return
+            if ad and not attr_of_self and "." not in ad:
+                store[name] = ad
+                return
+        store[name] = name
+
+    for node in ctx.tree.body:
+        if isinstance(node, ast.ClassDef):
+            cmap = decls.class_locks.setdefault(node.name, {})
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                ctor = _lock_ctor_call(sub.value)
+                if ctor is None:
+                    continue
+                for t in sub.targets:
+                    d = dotted(t)
+                    if d and d.startswith("self.") and "." not in d[5:]:
+                        record(cmap, d[5:], ctor[1], attr_of_self=True)
+        elif isinstance(node, ast.Assign):
+            ctor = _lock_ctor_call(node.value)
+            if ctor is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    record(decls.module_locks, t.id, ctor[1],
+                           attr_of_self=False)
+    return decls
+
+
+def _lock_node_id(expr: ast.AST, ctx: FileCtx, class_name: str | None,
+                  fn_name: str, decls: _LockDecls) -> str | None:
+    """Graph node id for a ``with <expr>:`` target when it names a lock.
+
+    Declared locks resolve through the alias map (condition == its
+    lock); undeclared lock-ish names still count, scoped to their
+    class / function so distinct objects stay distinct nodes."""
+    d = dotted(expr)
+    if d is None:
+        return None
+    if d.startswith("self.") and "." not in d[5:]:
+        attr = d[5:]
+        cname = class_name or "?"
+        amap = decls.class_locks.get(cname, {})
+        if attr in amap:
+            return f"{ctx.relpath}:{cname}.{decls.canonical_attr(cname, attr)}"
+        if _LOCKNAME_RE.search(attr):
+            return f"{ctx.relpath}:{cname}.{attr}"
+        return None
+    if "." not in d:
+        if d in decls.module_locks:
+            return f"{ctx.relpath}:{decls.module_locks[d]}"
+        if _LOCKNAME_RE.search(d):
+            # local binding / parameter: a per-function lock object
+            scope = f"{class_name}." if class_name else ""
+            return f"{ctx.relpath}:{scope}{fn_name}:{d}"
+        return None
+    last = d.rsplit(".", 1)[-1]
+    if _LOCKNAME_RE.search(last):
+        return f"{ctx.relpath}:{d}"
+    return None
+
+
+def _iter_functions(tree: ast.Module):
+    """Yields ``(class_name or None, fn_node)`` for every def in the
+    module, including defs nested in functions (class context kept)."""
+    def walk(body, class_name):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield class_name, node
+                yield from walk(node.body, class_name)
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body, node.name)
+            elif hasattr(node, "body") and isinstance(
+                    getattr(node, "body", None), list):
+                yield from walk(node.body, class_name)
+                for extra in ("orelse", "finalbody"):
+                    yield from walk(getattr(node, extra, []) or [],
+                                    class_name)
+                for h in getattr(node, "handlers", []) or []:
+                    yield from walk(h.body, class_name)
+    yield from walk(tree.body, None)
+
+
+# --------------------------------------------------------------------------
+# lock-order: interprocedural acquisition graph
+# --------------------------------------------------------------------------
+
+# names too generic to resolve across files (get/put/read/... exist on
+# dicts, queues and files as well as on lock-holding classes — resolving
+# them by name alone would fabricate edges)
+_GENERIC_NAMES = {"get", "put", "pop", "load", "save", "read", "write",
+                  "close", "open", "stop", "start", "run", "wait", "set",
+                  "clear", "stats", "submit", "send", "append", "update",
+                  "add", "remove", "join", "next", "items", "keys",
+                  "values", "copy", "acquire", "release"}
+
+
+@dataclass
+class _FnRecord:
+    ctx: FileCtx
+    class_name: str | None
+    name: str
+    acquires: list = field(default_factory=list)   # (lock_id, lineno)
+    # (outer_lock_id, callee_form, callee_name, lineno); callee_form is
+    # "self" (self.m()), "bare" (m()) or "any" (x.m() / chained)
+    calls_under: list = field(default_factory=list)
+    nested: list = field(default_factory=list)     # (outer, inner, lineno)
+
+
+@dataclass
+class LockEdge:
+    src: str
+    dst: str
+    ctx: FileCtx
+    line: int
+    via: str       # "nested with" | "call to f() -> file:line"
+
+
+def _scan_fn_locks(ctx: FileCtx, class_name: str | None, fn: ast.AST,
+                   decls: _LockDecls) -> _FnRecord:
+    rec = _FnRecord(ctx, class_name, fn.name)
+    lock_stack: list[str] = []
+
+    def callee_of(call: ast.Call) -> tuple[str, str] | None:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return "bare", f.id
+        if isinstance(f, ast.Attribute):
+            base = dotted(f.value)
+            if base == "self":
+                return "self", f.attr
+            return "any", f.attr
+        return None
+
+    def walk(stmts) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue   # nested defs get their own record
+            if isinstance(s, ast.With):
+                acquired = []
+                for item in s.items:
+                    lock = _lock_node_id(item.context_expr, ctx,
+                                         class_name, fn.name, decls)
+                    if lock is None:
+                        continue
+                    rec.acquires.append((lock, s.lineno))
+                    if lock_stack and lock_stack[-1] != lock:
+                        rec.nested.append((lock_stack[-1], lock, s.lineno))
+                    lock_stack.append(lock)
+                    acquired.append(lock)
+                walk(s.body)
+                for _ in acquired:
+                    lock_stack.pop()
+                continue
+            if lock_stack:
+                for sub in ast.walk(s):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        break
+                    if isinstance(sub, ast.Call):
+                        callee = callee_of(sub)
+                        if callee is not None:
+                            rec.calls_under.append(
+                                (lock_stack[-1], callee[0], callee[1],
+                                 sub.lineno))
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.stmt):
+                    walk([child])
+                elif hasattr(child, "body") and isinstance(
+                        getattr(child, "body", None), list):
+                    walk(child.body)
+
+    walk(fn.body)
+    return rec
+
+
+def build_lock_graph(files: list[FileCtx]) -> list[LockEdge]:
+    """Every lock-order edge in the package, with provenance. Direct
+    ``with A: with B:`` nesting plus one call level deep (a call under A
+    into a resolvable function that acquires B)."""
+    records: list[_FnRecord] = []
+    by_method: dict[tuple[str, str, str], _FnRecord] = {}
+    by_file_fn: dict[tuple[str, str], _FnRecord] = {}
+    by_name: dict[str, list[_FnRecord]] = {}
+    for ctx in files:
+        decls = _collect_lock_decls(ctx)
+        for class_name, fn in _iter_functions(ctx.tree):
+            rec = _scan_fn_locks(ctx, class_name, fn, decls)
+            records.append(rec)
+            if class_name:
+                by_method[(ctx.relpath, class_name, fn.name)] = rec
+            else:
+                by_file_fn.setdefault((ctx.relpath, fn.name), rec)
+            by_name.setdefault(fn.name, []).append(rec)
+
+    def resolve(rec: _FnRecord, form: str, name: str) -> _FnRecord | None:
+        if form == "self" and rec.class_name:
+            hit = by_method.get((rec.ctx.relpath, rec.class_name, name))
+            if hit is not None:
+                return hit
+        if form in ("self", "bare"):
+            hit = by_file_fn.get((rec.ctx.relpath, name))
+            if hit is not None:
+                return hit
+        if name in _GENERIC_NAMES:
+            return None
+        # cross-file: only a UNIQUE lock-acquiring definition resolves
+        cands = [r for r in by_name.get(name, ()) if r.acquires]
+        return cands[0] if len(cands) == 1 else None
+
+    edges: list[LockEdge] = []
+    for rec in records:
+        for outer, inner, line in rec.nested:
+            edges.append(LockEdge(outer, inner, rec.ctx, line,
+                                  "nested with"))
+        for outer, form, name, line in rec.calls_under:
+            callee = resolve(rec, form, name)
+            if callee is None or callee is rec:
+                continue
+            for lock, lline in callee.acquires:
+                if lock == outer:
+                    continue
+                edges.append(LockEdge(
+                    outer, lock, rec.ctx, line,
+                    f"call to {name}() acquiring it at "
+                    f"{callee.ctx.relpath}:{lline}"))
+    return edges
+
+
+def _short(node_id: str) -> str:
+    return node_id.split(":", 1)[-1]
+
+
+def lock_graph_dot(files: list[FileCtx]) -> str:
+    """The lock-order graph as DOT (``bst lint --graph lock-order``)."""
+    edges = build_lock_graph(files)
+    nodes: set[str] = set()
+    seen: set[tuple[str, str]] = set()
+    lines = ["digraph lock_order {", '  rankdir=LR;',
+             '  node [shape=box, fontsize=10];']
+    for e in edges:
+        nodes.update((e.src, e.dst))
+    for n in sorted(nodes):
+        lines.append(f'  "{n}" [label="{_short(n)}\\n'
+                     f'{n.split(":", 1)[0]}"];')
+    for e in edges:
+        if (e.src, e.dst) in seen:
+            continue
+        seen.add((e.src, e.dst))
+        lines.append(f'  "{e.src}" -> "{e.dst}" '
+                     f'[label="{e.ctx.relpath}:{e.line}"];')
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def _find_cycles(edges: list[LockEdge]) -> list[list[LockEdge]]:
+    """One representative cycle (as its edge path) per strongly
+    connected component of size > 1."""
+    adj: dict[str, list[LockEdge]] = {}
+    for e in edges:
+        adj.setdefault(e.src, []).append(e)
+
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[set[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan: (node, edge iterator) frames
+        work = [(v, iter(adj.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for e in it:
+                w = e.dst
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(adj.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.add(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(comp)
+
+    for e in edges:
+        if e.src not in index:
+            strongconnect(e.src)
+
+    cycles: list[list[LockEdge]] = []
+    for comp in sccs:
+        start = sorted(comp)[0]
+        # BFS within the component for the shortest path back to start
+        best: list[LockEdge] | None = None
+        frontier: list[tuple[str, list[LockEdge]]] = [(start, [])]
+        visited = {start}
+        while frontier and best is None:
+            nxt: list[tuple[str, list[LockEdge]]] = []
+            for node, path in frontier:
+                for e in adj.get(node, ()):
+                    if e.dst not in comp:
+                        continue
+                    if e.dst == start:
+                        best = path + [e]
+                        break
+                    if e.dst not in visited:
+                        visited.add(e.dst)
+                        nxt.append((e.dst, path + [e]))
+                if best is not None:
+                    break
+            frontier = nxt
+        if best:
+            cycles.append(best)
+    return cycles
+
+
+def check_lock_order(files: list[FileCtx]) -> list[Finding]:
+    out: list[Finding] = []
+    edges = build_lock_graph(files)
+    for cycle in _find_cycles(edges):
+        path = " -> ".join([_short(e.src) for e in cycle]
+                           + [_short(cycle[0].src)])
+        prov = "; ".join(f"{_short(e.src)}->{_short(e.dst)} at "
+                         f"{e.ctx.relpath}:{e.line} ({e.via})"
+                         for e in cycle)
+        anchor = cycle[0]
+        out.append(anchor.ctx.finding(
+            "lock-order", _Loc(anchor.line),
+            f"lock-order cycle (potential deadlock): {path} — two "
+            f"threads entering at different nodes deadlock. Edges: "
+            f"{prov}. Inspect with `bst lint --graph lock-order`"))
+    return out
+
+
+class _Loc:
+    """Minimal node stand-in carrying a line number for ctx.finding."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+
+
+# --------------------------------------------------------------------------
+# blocking-under-lock
+# --------------------------------------------------------------------------
+
+_SOCK_BLOCKING_ATTRS = {"send", "sendall", "sendto", "sendmsg", "recv",
+                        "recv_into", "recvfrom", "recvfrom_into",
+                        "recvmsg", "accept", "connect", "connect_ex",
+                        "readline"}
+_QUEUEISH_RE = re.compile(r"(^|[._])(q|queue|waiter|inbox|outbox)s?$",
+                          re.IGNORECASE)
+_CONTAINER_RECV_RE = re.compile(r"(^|[._])(ds|dataset|store|container)s?$",
+                                re.IGNORECASE)
+_CONTAINER_IO_ATTRS = {"read_block", "write_block", "prefetch_box"}
+_SLEEP_THRESHOLD_S = 0.1
+
+
+def _blocking_call_reason(call: ast.Call) -> str | None:
+    """Why this call can block indefinitely, or None when it cannot (as
+    far as the heuristic can tell)."""
+    d = dotted(call.func) or ""
+    last = d.rsplit(".", 1)[-1] if d else ""
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        recv = dotted(call.func.value) or ""
+        if attr in _SOCK_BLOCKING_ATTRS:
+            return f"socket/stream {attr}() can block on the peer"
+        if attr in ("get", "put") and _QUEUEISH_RE.search(recv):
+            kwnames = {k.arg for k in call.keywords}
+            if attr == "get" and call.args:
+                return None    # dict.get(key) style — not a queue get
+            if not ({"timeout", "block"} & kwnames):
+                return (f"queue {attr}() without block=False/timeout "
+                        f"blocks until a peer acts")
+            return None
+        if attr == "block_until_ready":
+            return "block_until_ready() waits on the device"
+        if attr in _CONTAINER_IO_ATTRS or (
+                attr in ("read", "write")
+                and _CONTAINER_RECV_RE.search(recv)):
+            return (f"container {attr}() is a (possibly remote) IO "
+                    f"round trip")
+    if d.startswith("subprocess."):
+        return f"{d}() blocks on a child process"
+    if d in ("jax.device_get", "device_get"):
+        return "jax.device_get blocks on the device"
+    if d in ("time.sleep", "sleep") and call.args:
+        a = call.args[0]
+        if (isinstance(a, ast.Constant)
+                and isinstance(a.value, (int, float))
+                and a.value > _SLEEP_THRESHOLD_S):
+            return f"time.sleep({a.value}) parks the lock holder"
+    if d in ("socket.create_connection", "create_connection"):
+        return "create_connection() blocks on the TCP handshake"
+    _ = last
+    return None
+
+
+def check_blocking_under_lock(files: list[FileCtx]) -> list[Finding]:
+    out: list[Finding] = []
+    for ctx in files:
+        decls = _collect_lock_decls(ctx)
+        # same-file helpers that contain a direct blocking call, for the
+        # one-call-deep expansion (catches send/recv wrapped in module
+        # helpers like _send_line / _recv_exact)
+        helper_blocks: dict[str, str] = {}
+        for class_name, fn in _iter_functions(ctx.tree):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    reason = _blocking_call_reason(node)
+                    if reason is not None:
+                        helper_blocks.setdefault(fn.name, reason)
+                        break
+
+        def scan_fn(class_name: str | None, fn: ast.AST) -> None:
+            lock_stack: list[str] = []
+
+            def flag_calls(s: ast.stmt) -> None:
+                for sub in ast.walk(s):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        return
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    reason = _blocking_call_reason(sub)
+                    name = None
+                    if isinstance(sub.func, ast.Name):
+                        name = sub.func.id
+                    elif (isinstance(sub.func, ast.Attribute)
+                          and dotted(sub.func.value) == "self"):
+                        name = sub.func.attr
+                    if reason is None and name is not None \
+                            and name != fn.name:
+                        helper = helper_blocks.get(name)
+                        if helper is not None:
+                            reason = (f"{name}() does blocking IO "
+                                      f"({helper})")
+                    if reason is not None:
+                        out.append(ctx.finding(
+                            "blocking-under-lock", sub,
+                            f"{reason} while {_short(lock_stack[-1])} is "
+                            f"held — every thread needing the lock "
+                            f"stalls behind it; move the call outside "
+                            f"the lock"))
+
+            def walk(stmts) -> None:
+                for s in stmts:
+                    if isinstance(s, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        continue
+                    if isinstance(s, ast.With):
+                        acquired = []
+                        for item in s.items:
+                            lock = _lock_node_id(item.context_expr, ctx,
+                                                 class_name, fn.name,
+                                                 decls)
+                            if lock is not None:
+                                lock_stack.append(lock)
+                                acquired.append(lock)
+                        walk(s.body)
+                        for _ in acquired:
+                            lock_stack.pop()
+                        continue
+                    if lock_stack:
+                        kids = [c for c in ast.iter_child_nodes(s)
+                                if isinstance(c, (ast.stmt, ast.expr))]
+                        # flag expressions at THIS level, then recurse
+                        # into statement bodies so nested withs are seen
+                        for c in kids:
+                            if isinstance(c, ast.expr):
+                                flag_calls(c)
+                        sub_stmts = [c for c in kids
+                                     if isinstance(c, ast.stmt)]
+                        if sub_stmts:
+                            walk(sub_stmts)
+                        for child in ast.iter_child_nodes(s):
+                            if hasattr(child, "body") and isinstance(
+                                    getattr(child, "body", None), list) \
+                                    and not isinstance(child, ast.stmt):
+                                walk(child.body)
+                    else:
+                        for child in ast.iter_child_nodes(s):
+                            if isinstance(child, ast.stmt):
+                                walk([child])
+                            elif hasattr(child, "body") and isinstance(
+                                    getattr(child, "body", None), list):
+                                walk(child.body)
+
+            walk(fn.body)
+
+        for class_name, fn in _iter_functions(ctx.tree):
+            scan_fn(class_name, fn)
+    return out
+
+
+# --------------------------------------------------------------------------
+# thread-spawn
+# --------------------------------------------------------------------------
+
+_SPAWN_EXEMPT_FILE = "utils/threads.py"
+
+
+def check_thread_spawn(files: list[FileCtx]) -> list[Finding]:
+    out: list[Finding] = []
+    for ctx in files:
+        if ctx.relpath == _SPAWN_EXEMPT_FILE:
+            continue
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            last = d.rsplit(".", 1)[-1]
+            if last == "Thread" and (d == "Thread"
+                                     or d.endswith("threading.Thread")
+                                     or d == "threading.Thread"):
+                out.append(ctx.finding(
+                    "thread-spawn", node,
+                    "raw threading.Thread drops config.overrides() "
+                    "contextvars and the ambient cancel token — spawn "
+                    "via utils.threads.ctx_thread (or justify with a "
+                    "suppression: process-lived daemon infrastructure "
+                    "must NOT pin one job's context)"))
+            elif last == "ThreadPoolExecutor":
+                out.append(ctx.finding(
+                    "thread-spawn", node,
+                    "raw ThreadPoolExecutor workers drop "
+                    "config.overrides() contextvars and the cancel "
+                    "token — use utils.threads.CtxThreadPool (or "
+                    "justify with a suppression)"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# cancel-coverage
+# --------------------------------------------------------------------------
+
+_CANCEL_SCOPES = ("models/", "parallel/", "dag/", "serve/")
+_STOPFLAG_RE = re.compile(r"stop|cancel|closed|done|drain|shutdown",
+                          re.IGNORECASE)
+
+
+def _worker_callables(ctx: FileCtx) -> set[tuple[str | None, str]]:
+    """(class or None, fn name) for every callable handed to a thread
+    spawn / pool submit in this file: ``Thread(target=X)``,
+    ``ctx_thread(X, ...)``, ``pool.submit(X, ...)``."""
+    out: set[tuple[str | None, str]] = set()
+
+    def record(expr: ast.AST) -> None:
+        d = dotted(expr)
+        if not d:
+            return
+        if d.startswith("self.") and "." not in d[5:]:
+            out.add((None, d[5:]))      # method: class resolved later
+        elif "." not in d:
+            out.add((None, d))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func) or ""
+        last = d.rsplit(".", 1)[-1]
+        if last in ("Thread", "Timer"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    record(kw.value)
+        elif last == "ctx_thread" and node.args:
+            record(node.args[0])
+        elif last in ("submit", "map") and isinstance(
+                node.func, ast.Attribute) and node.args:
+            record(node.args[0])
+    return out
+
+
+def _loop_polls_cancellation(loop: ast.While) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            d = dotted(node.func) or ""
+            parts = d.split(".")
+            attr = parts[-1]
+            recv = ".".join(parts[:-1])
+            if attr == "check" and ("cancel" in recv or recv.endswith(
+                    "_cancel")):
+                return True
+            if attr in ("cancelled", "is_cancelled"):
+                return True
+            if attr in ("is_set", "wait") and _STOPFLAG_RE.search(recv):
+                return True
+            if attr in ("get_nowait", "put_nowait"):
+                return True    # bounded drain: ends when the queue does
+        if isinstance(node, ast.Attribute) and node.attr and \
+                _STOPFLAG_RE.search(node.attr):
+            return True        # `if self._stopping: return` style flag
+        if isinstance(node, ast.Name) and _STOPFLAG_RE.search(node.id):
+            return True
+    return False
+
+
+def check_cancel_coverage(files: list[FileCtx]) -> list[Finding]:
+    out: list[Finding] = []
+    for ctx in files:
+        if not ctx.relpath.startswith(_CANCEL_SCOPES):
+            continue
+        workers = _worker_callables(ctx)
+        if not workers:
+            continue
+        worker_names = {name for _cls, name in workers}
+        for _class_name, fn in _iter_functions(ctx.tree):
+            if fn.name not in worker_names:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.While) and isinstance(
+                        node.test, ast.Constant) and node.test.value \
+                        is True:
+                    if not _loop_polls_cancellation(node):
+                        out.append(ctx.finding(
+                            "cancel-coverage", node,
+                            f"unbounded `while True:` in worker "
+                            f"callable {fn.name}() never polls "
+                            f"cancellation — call utils.cancel.check() "
+                            f"(or test a stop flag) in the loop body so "
+                            f"job cancel / daemon drain can reach it"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# socket-hygiene
+# --------------------------------------------------------------------------
+
+_SOCK_HELPER_FNS = {"_shutdown_close", "_close_sock"}
+_SOCK_PARAM_RE = re.compile(r"(^|_)(sock|conn)$", re.IGNORECASE)
+_SOCK_EXEMPT_PREFIX = "utils/"
+
+
+def _is_socket_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    d = dotted(value.func) or ""
+    if d in ("socket.socket", "socket.create_connection",
+             "create_connection", "socket.socketpair"):
+        return True
+    return isinstance(value.func, ast.Attribute) and \
+        value.func.attr == "accept"
+
+
+def _param_is_socket(arg: ast.arg) -> bool:
+    ann = getattr(arg, "annotation", None)
+    if ann is not None:
+        ad = dotted(ann)
+        if ad and ad.rsplit(".", 1)[-1] == "socket":
+            return True
+    return bool(_SOCK_PARAM_RE.search(arg.arg))
+
+
+def check_socket_hygiene(files: list[FileCtx]) -> list[Finding]:
+    out: list[Finding] = []
+    for ctx in files:
+        if ctx.relpath.startswith(_SOCK_EXEMPT_PREFIX):
+            continue
+        for _class_name, fn in _iter_functions(ctx.tree):
+            if fn.name in _SOCK_HELPER_FNS:
+                continue
+            socks: set[str] = set()
+            args = getattr(fn, "args", None)
+            if args is not None:
+                for a in (*args.posonlyargs, *args.args,
+                          *args.kwonlyargs):
+                    if a.arg != "self" and _param_is_socket(a):
+                        socks.add(a.arg)
+            server: set[str] = set()
+            shut: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node is not fn:
+                    continue
+                if isinstance(node, ast.Assign) and _is_socket_ctor(
+                        node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            socks.add(t.id)
+                        elif isinstance(t, ast.Tuple) and t.elts and \
+                                isinstance(t.elts[0], ast.Name):
+                            socks.add(t.elts[0].id)   # conn, addr = accept()
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if isinstance(f, ast.Attribute) and isinstance(
+                            f.value, ast.Name):
+                        if f.attr in ("bind", "listen"):
+                            server.add(f.value.id)
+                        elif f.attr == "shutdown":
+                            shut.add(f.value.id)
+                    if isinstance(f, ast.Name) and \
+                            f.id in _SOCK_HELPER_FNS and node.args and \
+                            isinstance(node.args[0], ast.Name):
+                        shut.add(node.args[0].id)
+                    if isinstance(f, ast.Attribute) and \
+                            f.attr in _SOCK_HELPER_FNS and node.args and \
+                            isinstance(node.args[0], ast.Name):
+                        shut.add(node.args[0].id)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "close"
+                        and isinstance(node.func.value, ast.Name)):
+                    continue
+                name = node.func.value.id
+                if name in socks and name not in server \
+                        and name not in shut:
+                    out.append(ctx.finding(
+                        "socket-hygiene", node,
+                        f"{name}.close() without a preceding "
+                        f"{name}.shutdown() — io-refs (makefile "
+                        f"wrappers) keep the fd alive past a bare "
+                        f"close, leaving a phantom half-open "
+                        f"connection the peer never notices; use "
+                        f"observe.relay._shutdown_close (shutdown "
+                        f"SHUT_RDWR, then close)"))
+    return out
+
+
+CONCURRENCY_CHECKS = {
+    "lock-order": check_lock_order,
+    "blocking-under-lock": check_blocking_under_lock,
+    "thread-spawn": check_thread_spawn,
+    "cancel-coverage": check_cancel_coverage,
+    "socket-hygiene": check_socket_hygiene,
+}
+
+ALL_CHECKS.update(CONCURRENCY_CHECKS)

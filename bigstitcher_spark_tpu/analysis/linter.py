@@ -80,23 +80,62 @@ def collect_files(root: Path) -> list[tuple[Path, str]]:
     return files
 
 
-def run_lint(root: Path | str,
-             checks: dict | None = None) -> list[Finding]:
-    """All unsuppressed findings for the package tree at ``root``."""
+# parse each file ONCE and share the AST across invocations: a full `bst
+# lint --all` plus the per-check smokes (and test_lint.py, which calls
+# run_lint dozens of times against the live package) would otherwise
+# re-read and re-parse the whole tree every call. Keyed by absolute path
+# and invalidated on (mtime_ns, size) change, so fixture trees rewritten
+# in place between runs are re-parsed. Checks must treat trees as
+# read-only — they all do (pure visitors).
+_AST_CACHE: dict[str, tuple[int, int, str, FileCtx, dict]] = {}
+
+
+def _parse_one(path: Path, rel: str) -> tuple[FileCtx | None, dict,
+                                              Finding | None]:
+    """(ctx, suppression table, parse-error finding) for one file, via
+    the shared cache."""
+    key = str(path)
+    st = path.stat()
+    hit = _AST_CACHE.get(key)
+    if hit is not None and hit[0] == st.st_mtime_ns and hit[1] == st.st_size \
+            and hit[2] == rel:
+        return hit[3], hit[4], None
+    source = path.read_text(encoding="utf-8")
+    try:
+        tree = ast.parse(source, filename=key)
+    except SyntaxError as e:
+        _AST_CACHE.pop(key, None)
+        return None, {}, Finding("parse", rel, e.lineno or 1,
+                                 f"syntax error: {e.msg}", "")
+    ctx = FileCtx(rel, tree, source.splitlines())
+    table = parse_suppressions(source)
+    _AST_CACHE[key] = (st.st_mtime_ns, st.st_size, rel, ctx, table)
+    return ctx, table, None
+
+
+def parse_package(root: Path | str) -> tuple[list[FileCtx],
+                                             dict[str, dict],
+                                             list[Finding]]:
+    """Parsed FileCtx list + per-file suppression tables + parse-error
+    findings for the tree at ``root`` (shared-AST cached)."""
     root = Path(root)
     ctxs: list[FileCtx] = []
     suppressions: dict[str, dict] = {}
-    findings: list[Finding] = []
+    errors: list[Finding] = []
     for path, rel in collect_files(root):
-        source = path.read_text(encoding="utf-8")
-        try:
-            tree = ast.parse(source, filename=str(path))
-        except SyntaxError as e:
-            findings.append(Finding("parse", rel, e.lineno or 1,
-                                    f"syntax error: {e.msg}", ""))
+        ctx, table, err = _parse_one(path, rel)
+        if err is not None:
+            errors.append(err)
             continue
-        ctxs.append(FileCtx(rel, tree, source.splitlines()))
-        suppressions[rel] = parse_suppressions(source)
+        ctxs.append(ctx)
+        suppressions[rel] = table
+    return ctxs, suppressions, errors
+
+
+def run_lint(root: Path | str,
+             checks: dict | None = None) -> list[Finding]:
+    """All unsuppressed findings for the package tree at ``root``."""
+    ctxs, suppressions, findings = parse_package(root)
     for name, fn in (checks or ALL_CHECKS).items():
         findings.extend(fn(ctxs))
     findings = [f for f in findings

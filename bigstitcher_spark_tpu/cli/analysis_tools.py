@@ -33,24 +33,38 @@ import click
               help="rewrite the baseline to the current findings")
 @click.option("--check", "only_checks", multiple=True,
               help="run only these checks (repeatable); default: all")
+@click.option("--graph", "graph_name",
+              type=click.Choice(["lock-order"]), default=None,
+              help="dump a check's computed graph as DOT instead of "
+                   "linting (lock-order: the whole-package lock-"
+                   "acquisition graph, for verifying cycle findings)")
 def lint_cmd(root, baseline_path, fail_on_new, show_all, update_baseline,
-             only_checks):
+             only_checks, graph_name):
     """Run the AST invariant analyzer over the package.
 
     Checks: host-sync (no hidden device round-trips in ops/ and models/),
-    lock-discipline (guarded state mutated lock-free; inconsistent lock
-    order), config-registry (no raw BST_* environment access outside
+    lock-discipline (guarded state mutated lock-free), lock-order
+    (cycles in the interprocedural lock-acquisition graph — potential
+    deadlocks; dump the graph with --graph lock-order),
+    blocking-under-lock (socket/queue/subprocess/device waits while a
+    lock is held), thread-spawn (raw Thread/ThreadPoolExecutor outside
+    utils/threads.py drop contextvars + cancel token), cancel-coverage
+    (unbounded worker loops must poll cancellation), socket-hygiene
+    (close() without shutdown() leaves phantom connections),
+    config-registry (no raw BST_* environment access outside
     config.py), env-mutation (no BST_* environment WRITES anywhere — a
     multi-job daemon shares one env; per-job values go through
     config.overrides), metric-name / span-name (every bst_* series and
     span literal declared once in observe/metric_names.py). Suppress a
-    single line with `# bst-lint: off=<check>`."""
+    single line with `# bst-lint: off=<check>` plus the justification."""
     from ..analysis import (
         ALL_CHECKS,
         default_baseline_path,
         default_root,
         load_baseline,
+        lock_graph_dot,
         new_findings,
+        parse_package,
         run_lint,
         save_baseline,
     )
@@ -58,6 +72,10 @@ def lint_cmd(root, baseline_path, fail_on_new, show_all, update_baseline,
     root = Path(root) if root else default_root()
     baseline_path = (Path(baseline_path) if baseline_path
                      else default_baseline_path(root))
+    if graph_name is not None:
+        ctxs, _suppressions, _errors = parse_package(root)
+        click.echo(lock_graph_dot(ctxs), nl=False)
+        return
     checks = None
     if only_checks:
         unknown = set(only_checks) - set(ALL_CHECKS)

@@ -114,10 +114,18 @@ class _Peer:
         self._stop = threading.Event()
         self._sock: socket.socket | None = None
         self._backoff = 1.0
+        # _fetch_lock serializes whole fetch round trips; _fetch_ref_lock
+        # guards ONLY the connection refs, so teardown can interrupt an
+        # in-flight round trip without waiting up to _FETCH_TIMEOUT_S for
+        # _fetch_lock to come free
         self._fetch_lock = threading.Lock()
+        self._fetch_ref_lock = threading.Lock()
         self._fetch_sock: socket.socket | None = None
         self._fetch_file = None
-        self._thread = threading.Thread(
+        # raw daemon thread on purpose: the sender is peer-lived, shared
+        # by every job in the process, and must not pin the first job's
+        # cancel scope or config overrides (what ctx_thread would capture)
+        self._thread = threading.Thread(  # bst-lint: off=thread-spawn
             target=self._run, name=f"bst-xhost-peer-{rank}", daemon=True)
         self._thread.start()
 
@@ -219,42 +227,75 @@ class _Peer:
             f"({self.address[0]}:{self.address[1]}) failed: {last}")
 
     def _fetch_once(self, root, path, pos) -> np.ndarray:
+        if self._stop.is_set():
+            raise ExchangeError("peer is stopped")
+        # one outstanding round trip per peer keeps the reply stream
+        # unambiguous, so blocking while _fetch_lock is held is the
+        # POINT of the lock: nothing else ever waits on it — teardown
+        # interrupts an in-flight round trip via _close_fetch's socket
+        # shutdown (under _fetch_ref_lock), never by taking this lock
         with self._fetch_lock:
-            if self._fetch_sock is None:
-                sock = socket.create_connection(self.address, timeout=5.0)
-                sock.settimeout(_FETCH_TIMEOUT_S)
-                _set_keepalive(sock)
-                _send_line(sock, {"t": "hello", "schema": SCHEMA,
-                                  "rank": self.my_rank})
-                self._fetch_sock = sock
-                self._fetch_file = sock.makefile("rb")
-            _send_line(self._fetch_sock, {
-                "t": "fetch", "root": root, "path": path,
-                "pos": list(pos)})
-            line = self._fetch_file.readline()
-            if not line:
-                raise ExchangeError("peer closed during fetch")
-            head = json.loads(line)
-            if not head.get("ok"):
-                raise ExchangeError(f"peer error: {head.get('error')}")
-            raw = _recv_exact(self._fetch_file, int(head["nbytes"]))
+            head, raw = self._fetch_roundtrip(root, path, pos)  # bst-lint: off=blocking-under-lock — round-trip serialization lock, interrupted via _close_fetch, see above
         arr = np.frombuffer(raw, dtype=np.dtype(head["dtype"]))
         return arr.reshape(tuple(head["shape"])).copy()
 
-    def _close_fetch(self) -> None:
-        with self._fetch_lock:
-            if self._fetch_file is not None:
+    def _fetch_roundtrip(self, root, path, pos) -> tuple[dict, bytes]:
+        """One fetch request/reply on the cached connection, opening it
+        on first use. Caller holds ``_fetch_lock``; the refs publish
+        under ``_fetch_ref_lock`` so ``_close_fetch`` can shut the
+        socket down mid-round-trip (the reader unblocks with EOF)."""
+        with self._fetch_ref_lock:
+            sock, f = self._fetch_sock, self._fetch_file
+        if sock is None:
+            sock = socket.create_connection(self.address, timeout=5.0)
+            sock.settimeout(_FETCH_TIMEOUT_S)
+            _set_keepalive(sock)
+            _send_line(sock, {"t": "hello", "schema": SCHEMA,
+                              "rank": self.my_rank})
+            f = sock.makefile("rb")
+            with self._fetch_ref_lock:
+                publish = not self._stop.is_set()
+                if publish:
+                    self._fetch_sock, self._fetch_file = sock, f
+            if not publish:
+                # stopped while connecting: tear the fresh connection
+                # down ourselves, _close_fetch already ran
                 with contextlib.suppress(OSError):
-                    self._fetch_file.close()
-                self._fetch_file = None
-            if self._fetch_sock is not None:
-                _shutdown_close(self._fetch_sock)
-                self._fetch_sock = None
+                    f.close()
+                _shutdown_close(sock)
+                raise ExchangeError("peer is stopped")
+        _send_line(sock, {"t": "fetch", "root": root, "path": path,
+                          "pos": list(pos)})
+        line = f.readline()
+        if not line:
+            raise ExchangeError("peer closed during fetch")
+        head = json.loads(line)
+        if not head.get("ok"):
+            raise ExchangeError(f"peer error: {head.get('error')}")
+        return head, _recv_exact(f, int(head["nbytes"]))
+
+    def _close_fetch(self) -> None:
+        """Interrupt-style teardown: swap the refs out under the tiny
+        ref lock (NEVER ``_fetch_lock`` — an in-flight round trip can
+        hold that for up to ``_FETCH_TIMEOUT_S``), then shut the socket
+        down FIRST so a reader blocked in ``readline`` unblocks with
+        EOF, and only then close the file wrapper."""
+        with self._fetch_ref_lock:
+            sock, f = self._fetch_sock, self._fetch_file
+            self._fetch_sock = self._fetch_file = None
+        if sock is not None:
+            _shutdown_close(sock)
+        if f is not None:
+            with contextlib.suppress(OSError):
+                f.close()
 
     def stop(self) -> None:
         self._stop.set()
-        self._thread.join(timeout=5.0)
+        # interrupt any in-flight fetch BEFORE joining the sender: a
+        # round trip wedged on a dead peer would otherwise hold stop()
+        # hostage for up to _FETCH_TIMEOUT_S per peer
         self._close_fetch()
+        self._thread.join(timeout=5.0)
 
 
 class Exchange:
@@ -291,7 +332,9 @@ class Exchange:
         srv.listen(16)
         srv.settimeout(0.5)
         self._server = srv
-        self._accept_thread = threading.Thread(
+        # raw daemon thread on purpose: the acceptor is exchange-lived
+        # and serves every job — it must not capture one job's context
+        self._accept_thread = threading.Thread(  # bst-lint: off=thread-spawn
             target=self._accept_loop, name="bst-xhost-server", daemon=True)
         self._accept_thread.start()
 
@@ -343,8 +386,10 @@ class Exchange:
             _set_keepalive(conn)
             with self._conns_lock:
                 self._conns.add(conn)
-            _PEERS.set(len(self._conns))
-            threading.Thread(target=self._serve_conn, args=(conn,),
+                _PEERS.set(len(self._conns))
+            # raw daemon thread on purpose: serves a PEER RANK's pushes
+            # for the life of its connection, on behalf of every job
+            threading.Thread(target=self._serve_conn, args=(conn,),  # bst-lint: off=thread-spawn
                              name="bst-xhost-conn", daemon=True).start()
         with contextlib.suppress(OSError):
             self._server.close()
@@ -391,7 +436,7 @@ class Exchange:
                 f.close()
             with self._conns_lock:
                 self._conns.discard(conn)
-            _PEERS.set(len(self._conns))
+                _PEERS.set(len(self._conns))
             _shutdown_close(conn)
             if rank is not None and not clean and not self._stop.is_set():
                 self.registry.remote_rank_dead(rank)

@@ -208,7 +208,10 @@ def _decode_pool():
     if _DECODE_POOL is None:
         from concurrent.futures import ThreadPoolExecutor
 
-        _DECODE_POOL = ThreadPoolExecutor(max_workers=8,
+        # raw executor on purpose: decode workers run GIL-releasing
+        # foreign calls only — they never read config or poll cancel,
+        # and the pool outlives any one job's context
+        _DECODE_POOL = ThreadPoolExecutor(max_workers=8,  # bst-lint: off=thread-spawn
                                           thread_name_prefix="n5decode")
     return _DECODE_POOL
 

@@ -114,7 +114,10 @@ class Prefetcher:
                 return
             n = max(1, threads())
             for i in range(n):
-                t = threading.Thread(target=self._worker, daemon=True,
+                # raw daemon threads on purpose (see class docstring):
+                # the pool is process-lived and must not pin one job's
+                # cancel scope or config overrides
+                t = threading.Thread(target=self._worker, daemon=True,  # bst-lint: off=thread-spawn
                                      name=f"bst-prefetch-{i}")
                 self._workers.append(t)
         chunkcache.set_prefetch_hook(self.on_cache_hit)

@@ -297,7 +297,9 @@ def start(port: int, host: str | None = None) -> Exporter:
             return _EXPORTER
         srv = http.server.ThreadingHTTPServer((host, int(port)), _Handler)
         srv.daemon_threads = True
-        th = threading.Thread(target=srv.serve_forever,
+        # raw daemon thread on purpose: process-lived exporter serving
+        # scrapes for every job, no job context to carry
+        th = threading.Thread(target=srv.serve_forever,  # bst-lint: off=thread-spawn
                               name="bst-http-exporter", daemon=True)
         th.start()
         _EXPORTER = Exporter(srv, th)

@@ -189,7 +189,10 @@ class RelayClient:
 
         _progress.set_live_tracking(True)
         _events.add_tap(self._tap)
-        self._thread = threading.Thread(target=self._run,
+        # raw daemon thread on purpose: the relay sender is process-lived
+        # telemetry infrastructure serving every job — it must not pin
+        # the starting job's cancel scope or config overrides
+        self._thread = threading.Thread(target=self._run,  # bst-lint: off=thread-spawn
                                         name="bst-relay-client",
                                         daemon=True)
         self._thread.start()
@@ -298,13 +301,17 @@ class RelayClient:
             return
         data = (json.dumps(msg, default=str) + "\n").encode()
         with _trace.span("relay.send", nbytes=len(data)):
+            # read the ref under the lock, send OUTSIDE it: a send that
+            # rides its 10s timeout must not stall _close_sock and the
+            # reconnect path behind it. A connection swapped mid-send
+            # errors out and _close_sock(expected) ignores the stale ref.
+            with self._sock_lock:
+                sock = self._sock
+            if sock is None:
+                _DROP_CONN.inc()
+                return
             try:
-                with self._sock_lock:
-                    sock = self._sock
-                    if sock is None:
-                        _DROP_CONN.inc()
-                        return
-                    sock.sendall(data)
+                sock.sendall(data)
             except OSError:
                 self._close_sock(sock)
                 _DROP_CONN.inc()
@@ -336,8 +343,7 @@ class RelayClient:
         try:
             sock.sendall(hello)
         except OSError:
-            with contextlib.suppress(OSError):
-                sock.close()
+            _shutdown_close(sock)
             self._next_connect = now + self._backoff
             return False
         with self._sock_lock:
@@ -349,7 +355,9 @@ class RelayClient:
         _trace.instant("relay.connect", item=f"{self.address[0]}:"
                                              f"{self.address[1]}")
         self.connected.set()
-        threading.Thread(target=self._reader, args=(sock,),
+        # raw daemon thread on purpose: connection-lived reader, same
+        # no-job-context rationale as the sender thread
+        threading.Thread(target=self._reader, args=(sock,),  # bst-lint: off=thread-spawn
                          name="bst-relay-reader", daemon=True).start()
         return True
 
@@ -507,7 +515,9 @@ class RelayCollector:
     def start(self) -> "RelayCollector":
         from . import httpexport as _httpexport
 
-        th = threading.Thread(target=self._accept_loop,
+        # raw daemon thread on purpose: the collector is a standalone
+        # process-lived service, no job context exists to carry
+        th = threading.Thread(target=self._accept_loop,  # bst-lint: off=thread-spawn
                               name="bst-relay-accept", daemon=True)
         th.start()
         self._threads.append(th)
@@ -547,7 +557,9 @@ class RelayCollector:
             # the handler blocks in a plain read — without keepalive a
             # no-FIN dead worker stays a phantom connected rank
             _set_keepalive(conn)
-            th = threading.Thread(target=self._handle, args=(conn,),
+            # raw daemon thread on purpose: per-rank collector handler,
+            # no job context exists in the collector process
+            th = threading.Thread(target=self._handle, args=(conn,),  # bst-lint: off=thread-spawn
                                   name="bst-relay-conn", daemon=True)
             th.start()
             # prune finished handlers so a long-lived daemon with flaky
@@ -606,8 +618,7 @@ class RelayCollector:
                         rank["connected"] = False
                         rank["conn"] = None
                 self._update_connected_gauge()
-            with contextlib.suppress(OSError):
-                conn.close()
+            _shutdown_close(conn)
 
     def _register(self, msg: dict, conn, wlock) -> dict:
         key = (str(msg.get("host")), int(msg.get("process_index") or 0),
@@ -778,8 +789,13 @@ class RelayCollector:
                 self._dumps[req] = pend
             for key, conn, wlock in targets:
                 try:
+                    # per-connection writer lock held across the send on
+                    # purpose: it serializes dump requests with the
+                    # handler's replies on the SAME socket, nothing else
+                    # contends for it, and the socket's own timeout
+                    # bounds the stall
                     with wlock:
-                        conn.sendall(line)
+                        conn.sendall(line)  # bst-lint: off=blocking-under-lock — single-writer serialization, see above
                     asked.append(key)
                 except OSError:
                     continue

@@ -23,7 +23,7 @@ def _one_shot(socket_path: str | None, req: dict,
             raise RuntimeError(resp.get("error", "daemon error"))
         return resp
     finally:
-        s.close()
+        protocol.close(s)
 
 
 def ping(socket_path: str | None = None, timeout: float = 5.0) -> dict:
@@ -116,4 +116,4 @@ def submit(socket_path: str | None, tool: str, args: list[str],
             if on_event is not None:
                 on_event(msg)
     finally:
-        s.close()
+        protocol.close(s)

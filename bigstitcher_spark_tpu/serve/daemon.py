@@ -39,6 +39,7 @@ import threading
 import time
 
 from .. import config, observe, profiling
+from ..observe.relay import _shutdown_close
 from ..observe import events, httpexport, metrics as _metrics, \
     trace as _trace
 from ..utils import cancel as _cancel
@@ -446,8 +447,10 @@ class Daemon:
         finally:
             with contextlib.suppress(OSError):
                 f.close()
-            with contextlib.suppress(OSError):
-                conn.close()
+            # shutdown before close: f is an io-ref on the same fd, so a
+            # bare close() would leave the connection half-open and the
+            # client hanging on a reply that cannot come
+            _shutdown_close(conn)
 
     def uptime_s(self) -> float:
         """Daemon uptime — the ONE place it is computed (ping, /status

@@ -19,6 +19,7 @@ Requests::
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import socket
@@ -69,3 +70,13 @@ def connect(socket_path: str | None = None,
         s.settimeout(timeout)
     s.connect(path)
     return s
+
+
+def close(sock: socket.socket) -> None:
+    """shutdown(SHUT_RDWR) then close: the makefile() io-ref clients
+    wrap around the connection keeps the fd alive past a bare close(),
+    so shutdown is what actually tells the daemon we hung up."""
+    with contextlib.suppress(OSError):
+        sock.shutdown(socket.SHUT_RDWR)
+    with contextlib.suppress(OSError):
+        sock.close()

@@ -171,7 +171,9 @@ class S3FakeServer:
         self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.port = self.httpd.server_address[1]
         self.endpoint = f"http://127.0.0.1:{self.port}"
-        self.thread = threading.Thread(target=self.httpd.serve_forever,
+        # raw daemon thread on purpose: test-fixture HTTP server, no job
+        # context exists to carry into it
+        self.thread = threading.Thread(target=self.httpd.serve_forever,  # bst-lint: off=thread-spawn
                                        daemon=True)
 
     def start(self):

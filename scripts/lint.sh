@@ -4,4 +4,15 @@
 # anywhere. Extra args pass through (e.g. --all, --check host-sync).
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec python -m bigstitcher_spark_tpu.cli.main lint --fail-on-new "$@"
+
+# every concurrency check resolves by name: a typo'd or unregistered
+# check name fails loudly here instead of silently scanning nothing
+for check in lock-order blocking-under-lock thread-spawn \
+             cancel-coverage socket-hygiene; do
+  python -m bigstitcher_spark_tpu.cli.main lint --check "$check" \
+    --fail-on-new >/dev/null
+done
+
+SECONDS=0
+python -m bigstitcher_spark_tpu.cli.main lint --fail-on-new "$@"
+echo "bst lint: full scan in ${SECONDS}s"

@@ -72,11 +72,24 @@ class TestPackageIsClean:
         progress.write_text(progress.read_text(encoding="utf-8") + (
             "\n\ndef _unlocked_drop():\n"
             "    _records.clear()\n"), encoding="utf-8")
+        solver = dst / "models" / "solver.py"
+        solver.write_text(solver.read_text(encoding="utf-8") + (
+            "\n\ndef _sneaky_spawn():\n"
+            "    import threading\n"
+            "    return threading.Thread(target=print)\n"), encoding="utf-8")
+        client = dst / "serve" / "client.py"
+        client.write_text(client.read_text(encoding="utf-8") + (
+            "\n\ndef _sneaky_close(addr):\n"
+            "    import socket\n"
+            "    s = socket.create_connection(addr)\n"
+            "    s.close()\n"), encoding="utf-8")
         findings = run_lint(dst)
         new = new_findings(findings, load_baseline(default_baseline_path()))
         checks = {f.check for f in new}
         assert "config-registry" in checks, [f.render() for f in new]
         assert "lock-discipline" in checks, [f.render() for f in new]
+        assert "thread-spawn" in checks, [f.render() for f in new]
+        assert "socket-hygiene" in checks, [f.render() for f in new]
 
 
 # -- layer 2: the analyzer against known fixtures --------------------------
@@ -188,7 +201,10 @@ class TestLockDisciplineCheck:
         fs = [f for f in run_lint(tmp_path) if f.check == "lock-discipline"]
         assert [f.line for f in fs] == [14]
 
-    def test_inconsistent_lock_order(self, tmp_path):
+
+class TestLockOrderCheck:
+    def test_two_lock_inversion_is_a_cycle(self, tmp_path):
+        # the old single-file A->B/B->A heuristic, now a graph cycle
         _write_tree(tmp_path, {"mod.py": """
             import threading
 
@@ -207,8 +223,381 @@ class TestLockDisciplineCheck:
                     with LOCK_A:
                         pass
             """})
-        fs = [f for f in run_lint(tmp_path) if f.check == "lock-discipline"]
-        assert len(fs) == 1 and "inconsistent lock order" in fs[0].message
+        fs = [f for f in run_lint(tmp_path) if f.check == "lock-order"]
+        assert len(fs) == 1 and "potential deadlock" in fs[0].message
+        assert "--graph lock-order" in fs[0].message
+
+    def test_three_lock_interprocedural_cycle(self, tmp_path):
+        # A->B and B->C are direct nestings; C->A only exists one call
+        # level deep (three() calls take_a() under LOCK_C) — the planted
+        # cycle the per-pair heuristic could never see
+        _write_tree(tmp_path, {"mod.py": """
+            import threading
+
+            LOCK_A = threading.Lock()
+            LOCK_B = threading.Lock()
+            LOCK_C = threading.Lock()
+
+
+            def one():
+                with LOCK_A:
+                    with LOCK_B:
+                        pass
+
+
+            def two():
+                with LOCK_B:
+                    with LOCK_C:
+                        pass
+
+
+            def three():
+                with LOCK_C:
+                    take_a()
+
+
+            def take_a():
+                with LOCK_A:
+                    pass
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "lock-order"]
+        assert len(fs) == 1, [f.render() for f in fs]
+        assert "LOCK_A" in fs[0].message and "LOCK_C" in fs[0].message
+
+    def test_one_way_ordering_is_clean(self, tmp_path):
+        # a consistent global order (cache -> tier, never back) is the
+        # live package's shape and must not be flagged
+        _write_tree(tmp_path, {"mod.py": """
+            import threading
+
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def drop(self, tier):
+                    with self._lock:
+                        tier.keys()
+
+
+            class Tier:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def keys(self):
+                    with self._lock:
+                        return []
+            """})
+        assert [f for f in run_lint(tmp_path)
+                if f.check == "lock-order"] == []
+
+    def test_condition_aliases_to_its_lock(self, tmp_path):
+        # Condition(self._lock) IS self._lock: entering the condition in
+        # one method and the lock in another around the same second lock
+        # inverts the order — one node, real 2-cycle
+        _write_tree(tmp_path, {"mod.py": """
+            import threading
+
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition(self._lock)
+                    self._side_lock = threading.Lock()
+
+                def a(self):
+                    with self._cv:
+                        with self._side_lock:
+                            pass
+
+                def b(self):
+                    with self._side_lock:
+                        with self._lock:
+                            pass
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "lock-order"]
+        assert len(fs) == 1, [f.render() for f in fs]
+
+    def test_dot_export_lists_edges(self, tmp_path):
+        from bigstitcher_spark_tpu.analysis import (
+            lock_graph_dot,
+            parse_package,
+        )
+
+        _write_tree(tmp_path, {"mod.py": """
+            import threading
+
+            LOCK_A = threading.Lock()
+            LOCK_B = threading.Lock()
+
+
+            def one():
+                with LOCK_A:
+                    with LOCK_B:
+                        pass
+            """})
+        ctxs, _sup, _err = parse_package(tmp_path)
+        dot = lock_graph_dot(ctxs)
+        assert dot.startswith("digraph lock_order")
+        assert "LOCK_A" in dot and "->" in dot
+
+
+class TestBlockingUnderLockCheck:
+    def test_recv_and_queue_get_under_lock(self, tmp_path):
+        _write_tree(tmp_path, {"mod.py": """
+            import threading
+
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = None
+
+                def bad_recv(self, sock):
+                    with self._lock:
+                        data = sock.recv(4096)      # line 11
+                    return data
+
+                def bad_get(self):
+                    with self._lock:
+                        return self._q.get()        # line 16
+
+                def ok_nowait(self):
+                    with self._lock:
+                        return self._q.get_nowait()
+
+                def ok_outside(self, sock):
+                    with self._lock:
+                        pending = True
+                    return sock.recv(4096)
+            """})
+        fs = [f for f in run_lint(tmp_path)
+              if f.check == "blocking-under-lock"]
+        assert sorted(f.line for f in fs) == [11, 16]
+
+    def test_helper_one_call_deep(self, tmp_path):
+        # the exchange.py shape: the blocking sendall hides one call
+        # level down in a module helper, flagged at the call site
+        _write_tree(tmp_path, {"mod.py": """
+            import threading
+
+            _LOCK = threading.Lock()
+
+
+            def _send_line(sock, data):
+                sock.sendall(data)
+
+
+            def bad(sock, data):
+                with _LOCK:
+                    _send_line(sock, data)          # line 12
+            """})
+        fs = [f for f in run_lint(tmp_path)
+              if f.check == "blocking-under-lock"]
+        assert [f.line for f in fs] == [12]
+
+    def test_long_sleep_and_subprocess(self, tmp_path):
+        _write_tree(tmp_path, {"mod.py": """
+            import subprocess
+            import threading
+            import time
+
+            _LOCK = threading.Lock()
+
+
+            def bad():
+                with _LOCK:
+                    time.sleep(5.0)                          # line 10
+                    subprocess.run(["ls"], check=False)      # line 11
+
+
+            def ok_tick():
+                with _LOCK:
+                    time.sleep(0.01)    # sub-threshold tick
+            """})
+        fs = [f for f in run_lint(tmp_path)
+              if f.check == "blocking-under-lock"]
+        assert sorted(f.line for f in fs) == [10, 11]
+
+
+class TestThreadSpawnCheck:
+    def test_raw_spawns_flagged(self, tmp_path):
+        _write_tree(tmp_path, {"models/worker.py": """
+            import threading
+            from concurrent.futures import ThreadPoolExecutor
+
+
+            def spawn(fn):
+                t = threading.Thread(target=fn)     # line 6
+                pool = ThreadPoolExecutor(4)        # line 7
+                return t, pool
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "thread-spawn"]
+        assert sorted(f.line for f in fs) == [6, 7]
+        assert all("ctx" in f.message.lower() for f in fs)
+
+    def test_utils_threads_is_the_sanctioned_home(self, tmp_path):
+        _write_tree(tmp_path, {"utils/threads.py": """
+            import threading
+
+
+            def ctx_thread(fn, name=None):
+                return threading.Thread(target=fn, name=name, daemon=True)
+            """})
+        assert [f for f in run_lint(tmp_path)
+                if f.check == "thread-spawn"] == []
+
+    def test_ctx_thread_calls_are_clean(self, tmp_path):
+        _write_tree(tmp_path, {"dag/runner.py": """
+            from ..utils.threads import ctx_thread
+
+
+            def start(fn):
+                return ctx_thread(fn, name="worker")
+            """})
+        assert [f for f in run_lint(tmp_path)
+                if f.check == "thread-spawn"] == []
+
+
+class TestCancelCoverageCheck:
+    def test_poll_free_worker_loop_flagged(self, tmp_path):
+        _write_tree(tmp_path, {"dag/pump.py": """
+            from ..utils.threads import ctx_thread
+
+
+            class Pump:
+                def start(self):
+                    ctx_thread(self._loop, name="pump")
+
+                def _loop(self):
+                    while True:                     # line 9
+                        self.step()
+
+                def step(self):
+                    pass
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "cancel-coverage"]
+        assert [f.line for f in fs] == [9]
+        assert "cancel" in fs[0].message
+
+    def test_stop_flag_poll_is_clean(self, tmp_path):
+        _write_tree(tmp_path, {"serve/pump.py": """
+            import threading
+            from ..utils.threads import ctx_thread
+
+
+            class Pump:
+                def __init__(self):
+                    self._stop = threading.Event()
+
+                def start(self):
+                    ctx_thread(self._loop, name="pump")
+
+                def _loop(self):
+                    while True:
+                        if self._stop.is_set():
+                            return
+                        self.step()
+
+                def step(self):
+                    pass
+            """})
+        assert [f for f in run_lint(tmp_path)
+                if f.check == "cancel-coverage"] == []
+
+    def test_non_worker_and_out_of_scope_loops_clean(self, tmp_path):
+        _write_tree(tmp_path, {
+            # not a thread target: a main-thread convergence loop
+            "models/solve.py": """
+                def iterate(step):
+                    while True:
+                        if step():
+                            break
+                """,
+            # a worker loop, but io/ is outside the policed dirs
+            "io/pump.py": """
+                from ..utils.threads import ctx_thread
+
+
+                def start():
+                    ctx_thread(_loop)
+
+
+                def _loop():
+                    while True:
+                        pass
+                """})
+        assert [f for f in run_lint(tmp_path)
+                if f.check == "cancel-coverage"] == []
+
+
+class TestSocketHygieneCheck:
+    def test_shutdown_less_close_flagged(self, tmp_path):
+        _write_tree(tmp_path, {"net/conn.py": """
+            import socket
+
+
+            def leak(addr):
+                s = socket.create_connection(addr)
+                s.close()                           # line 6
+
+
+            def clean(addr):
+                s = socket.create_connection(addr)
+                s.shutdown(socket.SHUT_RDWR)
+                s.close()
+
+
+            def helper_clean(addr):
+                s = socket.create_connection(addr)
+                _shutdown_close(s)
+
+
+            def _shutdown_close(sock):
+                sock.shutdown(socket.SHUT_RDWR)
+                sock.close()
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "socket-hygiene"]
+        assert [f.line for f in fs] == [6]
+        assert "shutdown" in fs[0].message
+
+    def test_accepted_conn_param_flagged(self, tmp_path):
+        # the daemon/relay handler shape: the socket arrives as a
+        # parameter, recognized by annotation or sock/conn naming
+        _write_tree(tmp_path, {"net/handler.py": """
+            import socket
+
+
+            def handle(conn: socket.socket):
+                f = conn.makefile("rb")
+                f.close()
+                conn.close()                        # line 7
+            """})
+        fs = [f for f in run_lint(tmp_path) if f.check == "socket-hygiene"]
+        assert [f.line for f in fs] == [7]
+
+    def test_listener_and_utils_exempt(self, tmp_path):
+        _write_tree(tmp_path, {
+            "net/server.py": """
+                import socket
+
+
+                def serve(port):
+                    srv = socket.socket()
+                    srv.bind(("", port))
+                    srv.listen(4)
+                    srv.close()     # listener: shutdown is meaningless
+                """,
+            "utils/sockets.py": """
+                import socket
+
+
+                def quick(addr):
+                    s = socket.create_connection(addr)
+                    s.close()       # utils/-level helper: exempt
+                """})
+        assert [f for f in run_lint(tmp_path)
+                if f.check == "socket-hygiene"] == []
 
 
 class TestConfigRegistryCheck:

@@ -358,6 +358,58 @@ class TestExchangeProtocol:
             exchange.Exchange(3, [("127.0.0.1", _free_port())],
                               registry=stream.StreamRegistry())
 
+    def test_stop_interrupts_inflight_fetch(self):
+        """Teardown regression (found by `bst lint` blocking-under-lock):
+        _close_fetch used to take _fetch_lock, which an in-flight fetch
+        holds for up to the 30s round-trip timeout — a peer dying
+        mid-fetch wedged stop() for the full timeout. Teardown now shuts
+        the socket down under the separate ref lock, so the blocked
+        reader unblocks with EOF and stop() returns promptly."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        conns = []
+
+        def silent_server():
+            # accept the fetch connection, then never reply: the fetch
+            # round trip stays blocked in readline until interrupted
+            while True:
+                try:
+                    c, _ = srv.accept()
+                except OSError:
+                    return
+                conns.append(c)
+
+        threading.Thread(target=silent_server, daemon=True).start()
+        peer = exchange._Peer(1, srv.getsockname(), 0, queue_max=8)
+        errs = []
+        fetch_done = threading.Event()
+
+        def do_fetch():
+            try:
+                peer.fetch("root", "s0", (0, 0, 0))
+            except exchange.ExchangeError as e:
+                errs.append(e)
+            fetch_done.set()
+
+        threading.Thread(target=do_fetch, daemon=True).start()
+        deadline = time.monotonic() + 10
+        while not conns:
+            assert time.monotonic() < deadline, "fetch never connected"
+            time.sleep(0.02)
+        time.sleep(0.2)    # let the fetch enter its blocked readline
+        t0 = time.monotonic()
+        peer.stop()
+        stop_s = time.monotonic() - t0
+        # well under the 30s fetch timeout the old teardown waited out
+        assert stop_s < 10.0, f"stop() wedged for {stop_s:.1f}s"
+        assert fetch_done.wait(10.0), "interrupted fetch never returned"
+        assert errs, "fetch must raise ExchangeError after teardown"
+        srv.close()
+        for c in conns:
+            c.close()
+
     def test_two_rank_streaming_world(self, tmp_path):
         """The full exchange contract in one simulated two-rank world
         (two private registries + two TCP endpoints in one process):
